@@ -1,0 +1,16 @@
+"""caffe_mpi_tpu — a TPU-native training framework with the capabilities of
+Caffe-MPI (Inspur's MPI+NCCL multi-node NVCaffe fork), rebuilt idiomatically
+on JAX/XLA rather than ported.
+
+Architecture (vs the reference at /root/reference):
+- declarative prototxt net/solver configs       -> proto/       (pure-Python parser + schema)
+- Blob/Tensor/SyncedMemory + CUB pool           -> core/        (jax.Array substrate, dtype policy)
+- 124 CUDA/cuDNN layers                          -> ops/ layers/ (pure jit-compatible functions)
+- Net graph runtime (net.cpp)                    -> net.py       (graph -> one compiled train step)
+- 6 solvers w/ fused CUDA update kernels         -> solver/      (pure update fns fused by XLA)
+- MPI+NCCL allreduce (parallel.cpp)              -> parallel/    (Mesh + psum over ICI)
+- DataReader/prefetch threads                    -> data/        (host pipeline, double-buffered feed)
+- caffe CLI (tools/caffe.cpp)                    -> tools/       (train/test/time/device_query)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,37 @@
+"""Config layer: prototxt text-format parsing + typed Caffe parameter schema."""
+
+from .text_format import PbEnum, PbNode, PrototxtError, parse, parse_file
+from .config import (
+    AccuracyParameter,
+    BatchNormParameter,
+    BiasParameter,
+    BlobShape,
+    ConcatParameter,
+    ConvolutionParameter,
+    DataParameter,
+    DropoutParameter,
+    DummyDataParameter,
+    EltwiseParameter,
+    FillerParameter,
+    InnerProductParameter,
+    InputParameter,
+    LayerParameter,
+    LossParameter,
+    LRNParameter,
+    Message,
+    NetParameter,
+    NetState,
+    NetStateRule,
+    ParamSpec,
+    PoolingParameter,
+    ReLUParameter,
+    ScaleParameter,
+    SliceParameter,
+    SoftmaxParameter,
+    SolverParameter,
+    TransformationParameter,
+    solver_type,
+)
+from .upgrade import filter_net, layer_included, normalize_net, state_meets_rule
+
+__all__ = [s for s in dir() if not s.startswith("_")]

@@ -1,0 +1,787 @@
+"""Typed configuration schema — the Caffe parameter surface, in dataclasses.
+
+Mirrors the *semantics* of the reference's protobuf schema
+(/root/reference/src/caffe/proto/caffe.proto, 1,573 lines): NetParameter,
+LayerParameter (with per-op sub-messages), SolverParameter, fillers, net-state
+rules, precision/dtype fields. The reference compiles this schema with protoc;
+here each message is a dataclass coerced from the untyped text-format tree
+(`text_format.PbNode`), which keeps the whole config layer importable Python
+with no codegen while reading the reference's own prototxt files.
+
+Only fields the TPU framework interprets are declared; unknown fields parse
+fine (they stay in the PbNode) and are reported by `Message.unknown_fields`
+rather than crashing, mirroring proto2's tolerant-reader behavior.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from dataclasses import dataclass, field as dc_field
+from typing import Any, get_args, get_origin
+
+from .text_format import PbEnum, PbNode, parse, parse_file
+
+
+# ---------------------------------------------------------------------------
+# Coercion machinery
+# ---------------------------------------------------------------------------
+
+def _coerce_scalar(value: Any, target: type) -> Any:
+    if target is float:
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            return float(value)
+    elif target is int:
+        if isinstance(value, bool):
+            raise TypeError("bool where int expected")
+        if isinstance(value, int):
+            return value
+        if isinstance(value, float) and value.is_integer():
+            return int(value)
+    elif target is bool:
+        if isinstance(value, bool):
+            return value
+        if isinstance(value, PbEnum) and value in ("true", "false"):
+            return value == "true"
+        if isinstance(value, int) and value in (0, 1):
+            return bool(value)
+    elif target is str:
+        if isinstance(value, str):
+            return str(value)
+    raise TypeError(f"cannot coerce {value!r} to {target.__name__}")
+
+
+_SCHEMA_CACHE: dict[type, tuple] = {}
+
+
+@dataclass
+class Message:
+    """Base for all schema messages; subclasses are plain dataclasses."""
+
+    @classmethod
+    def _schema(cls):
+        """Per-class (fields, resolved hints) cache — from_node runs once per
+        node in a net with hundreds of layers, so hint resolution must not."""
+        cached = _SCHEMA_CACHE.get(cls)
+        if cached is None:
+            cached = (dataclasses.fields(cls), typing.get_type_hints(cls))
+            _SCHEMA_CACHE[cls] = cached
+        return cached
+
+    @classmethod
+    def from_node(cls, node: PbNode):
+        fields, hints = cls._schema()
+        kwargs: dict[str, Any] = {}
+        known = set()
+        for f in fields:
+            if f.name.startswith("_"):
+                continue
+            known.add(f.name)
+            target = hints[f.name]
+            vals = node.get_list(f.name)
+            if not vals:
+                continue
+            origin = get_origin(target)
+            if origin is typing.Union or str(origin) == "<class 'types.UnionType'>":
+                non_none = [a for a in get_args(target) if a is not type(None)]
+                target = non_none[0]
+                origin = get_origin(target)
+            try:
+                if origin in (list, tuple):
+                    (elem,) = get_args(target)[:1]
+                    kwargs[f.name] = [_coerce_value(v, elem, f.name) for v in vals]
+                else:
+                    kwargs[f.name] = _coerce_value(vals[-1], target, f.name)
+            except TypeError as e:
+                raise TypeError(f"{cls.__name__}.{f.name}: {e}") from e
+        obj = cls(**kwargs)
+        obj._unknown = sorted(set(node.keys()) - known)
+        obj._node = node
+        return obj
+
+    @classmethod
+    def from_text(cls, text: str):
+        return cls.from_node(parse(text))
+
+    @classmethod
+    def from_file(cls, path: str):
+        return cls.from_node(parse_file(path))
+
+    @property
+    def unknown_fields(self) -> list[str]:
+        return getattr(self, "_unknown", [])
+
+    def has(self, name: str) -> bool:
+        """proto2-style presence test: was the field set in the source text?"""
+        node = getattr(self, "_node", None)
+        return node is not None and name in node
+
+
+def _coerce_value(value: Any, target: Any, fname: str) -> Any:
+    if isinstance(target, type) and issubclass(target, Message):
+        if not isinstance(value, PbNode):
+            raise TypeError(f"expected message for {fname}, got {value!r}")
+        return target.from_node(value)
+    if target is Any:
+        return value
+    if isinstance(value, PbNode):
+        raise TypeError(f"unexpected message value for scalar field {fname}")
+    return _coerce_scalar(value, target)
+
+
+def _rep() -> Any:
+    return dc_field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# Fillers  (reference: caffe.proto FillerParameter; src/caffe/filler.hpp)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class FillerParameter(Message):
+    type: str = "constant"
+    value: float = 0.0
+    min: float = 0.0
+    max: float = 1.0
+    mean: float = 0.0
+    std: float = 1.0
+    sparse: int = -1
+    # xavier/msra normalization choice: FAN_IN / FAN_OUT / AVERAGE
+    variance_norm: str = "FAN_IN"
+
+
+# ---------------------------------------------------------------------------
+# Shapes and per-param config
+# ---------------------------------------------------------------------------
+
+@dataclass
+class BlobShape(Message):
+    dim: list[int] = _rep()
+
+
+@dataclass
+class ParamSpec(Message):
+    """Per-learnable-param training config (caffe.proto ParamSpec):
+    shared-weight naming, lr/decay multipliers."""
+    name: str = ""
+    lr_mult: float = 1.0
+    decay_mult: float = 1.0
+    # share_mode STRICT/PERMISSIVE accepted but sharing always requires
+    # identical shapes in this framework
+    share_mode: str = "STRICT"
+
+
+@dataclass
+class NetStateRule(Message):
+    """Phase/level/stage inclusion rule (caffe.proto NetStateRule;
+    evaluated in reference net.cpp:435-498)."""
+    phase: str = ""
+    min_level: int = -(2**31)
+    max_level: int = 2**31 - 1
+    stage: list[str] = _rep()
+    not_stage: list[str] = _rep()
+
+
+@dataclass
+class NetState(Message):
+    phase: str = "TEST"
+    level: int = 0
+    stage: list[str] = _rep()
+
+
+# ---------------------------------------------------------------------------
+# Op parameter sub-messages
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ConvolutionParameter(Message):
+    num_output: int = 0
+    bias_term: bool = True
+    pad: list[int] = _rep()
+    kernel_size: list[int] = _rep()
+    stride: list[int] = _rep()
+    dilation: list[int] = _rep()
+    pad_h: int = 0
+    pad_w: int = 0
+    kernel_h: int = 0
+    kernel_w: int = 0
+    stride_h: int = 0
+    stride_w: int = 0
+    group: int = 1
+    weight_filler: FillerParameter | None = None
+    bias_filler: FillerParameter | None = None
+    axis: int = 1
+    force_nd_im2col: bool = False
+    # engine CAFFE/CUDNN accepted and ignored: XLA picks conv algorithms,
+    # replacing the reference's cuDNN algo auto-seek
+    # (reference cudnn_conv_layer.cpp).
+    engine: str = "DEFAULT"
+    cudnn_math_override: int = -1
+
+
+@dataclass
+class PoolingParameter(Message):
+    pool: str = "MAX"  # MAX / AVE / STOCHASTIC
+    pad: int = 0
+    pad_h: int = 0
+    pad_w: int = 0
+    kernel_size: int = 0
+    kernel_h: int = 0
+    kernel_w: int = 0
+    stride: int = 1
+    stride_h: int = 0
+    stride_w: int = 0
+    global_pooling: bool = False
+    engine: str = "DEFAULT"
+    # reference rounds output size UP (ceil) — see pooling_layer.cpp
+    round_mode: str = "CEIL"
+
+
+@dataclass
+class InnerProductParameter(Message):
+    num_output: int = 0
+    bias_term: bool = True
+    weight_filler: FillerParameter | None = None
+    bias_filler: FillerParameter | None = None
+    axis: int = 1
+    transpose: bool = False
+
+
+@dataclass
+class ReLUParameter(Message):
+    negative_slope: float = 0.0
+    engine: str = "DEFAULT"
+
+
+@dataclass
+class PReLUParameter(Message):
+    filler: FillerParameter | None = None
+    channel_shared: bool = False
+
+
+@dataclass
+class ELUParameter(Message):
+    alpha: float = 1.0
+
+
+@dataclass
+class SigmoidParameter(Message):
+    engine: str = "DEFAULT"
+
+
+@dataclass
+class TanHParameter(Message):
+    engine: str = "DEFAULT"
+
+
+@dataclass
+class PowerParameter(Message):
+    power: float = 1.0
+    scale: float = 1.0
+    shift: float = 0.0
+
+
+@dataclass
+class ExpParameter(Message):
+    base: float = -1.0
+    scale: float = 1.0
+    shift: float = 0.0
+
+
+@dataclass
+class LogParameter(Message):
+    base: float = -1.0
+    scale: float = 1.0
+    shift: float = 0.0
+
+
+@dataclass
+class ThresholdParameter(Message):
+    threshold: float = 0.0
+
+
+@dataclass
+class DropoutParameter(Message):
+    dropout_ratio: float = 0.5
+    engine: str = "DEFAULT"
+
+
+@dataclass
+class LRNParameter(Message):
+    local_size: int = 5
+    alpha: float = 1.0
+    beta: float = 0.75
+    norm_region: str = "ACROSS_CHANNELS"
+    k: float = 1.0
+    engine: str = "DEFAULT"
+
+
+@dataclass
+class BatchNormParameter(Message):
+    use_global_stats: bool = False  # presence matters; see has("use_global_stats")
+    moving_average_fraction: float = 0.999
+    eps: float = 1e-5
+    # NVCaffe extension: fused scale+bias inside BN
+    scale_bias: bool = False
+    scale_filler: FillerParameter | None = None
+    bias_filler: FillerParameter | None = None
+
+
+@dataclass
+class ScaleParameter(Message):
+    axis: int = 1
+    num_axes: int = 1
+    filler: FillerParameter | None = None
+    bias_term: bool = False
+    bias_filler: FillerParameter | None = None
+
+
+@dataclass
+class BiasParameter(Message):
+    axis: int = 1
+    num_axes: int = 1
+    filler: FillerParameter | None = None
+
+
+@dataclass
+class MVNParameter(Message):
+    normalize_variance: bool = True
+    across_channels: bool = False
+    eps: float = 1e-9
+
+
+@dataclass
+class SoftmaxParameter(Message):
+    axis: int = 1
+    engine: str = "DEFAULT"
+
+
+@dataclass
+class LossParameter(Message):
+    ignore_label: int | None = None
+    normalization: str = "VALID"  # FULL / VALID / BATCH_SIZE / NONE
+    normalize: bool = True  # legacy pre-normalization flag
+
+
+@dataclass
+class AccuracyParameter(Message):
+    top_k: int = 1
+    axis: int = 1
+    ignore_label: int | None = None
+
+
+@dataclass
+class HingeLossParameter(Message):
+    norm: str = "L1"  # L1 / L2
+
+
+@dataclass
+class InfogainLossParameter(Message):
+    source: str = ""
+
+
+@dataclass
+class ContrastiveLossParameter(Message):
+    margin: float = 1.0
+    legacy_version: bool = False
+
+
+@dataclass
+class EltwiseParameter(Message):
+    operation: str = "SUM"  # PROD / SUM / MAX
+    coeff: list[float] = _rep()
+    stable_prod_grad: bool = True
+
+
+@dataclass
+class ConcatParameter(Message):
+    axis: int = 1
+    concat_dim: int = 1  # legacy
+
+
+@dataclass
+class SliceParameter(Message):
+    axis: int = 1
+    slice_point: list[int] = _rep()
+    slice_dim: int = 1  # legacy
+
+
+@dataclass
+class FlattenParameter(Message):
+    axis: int = 1
+    end_axis: int = -1
+
+
+@dataclass
+class ReshapeParameter(Message):
+    shape: BlobShape | None = None
+    axis: int = 0
+    num_axes: int = -1
+
+
+@dataclass
+class CropParameter(Message):
+    axis: int = 2
+    offset: list[int] = _rep()
+
+
+@dataclass
+class TileParameter(Message):
+    axis: int = 1
+    tiles: int = 0
+
+
+@dataclass
+class ReductionParameter(Message):
+    operation: str = "SUM"  # SUM / ASUM / SUMSQ / MEAN
+    axis: int = 0
+    coeff: float = 1.0
+
+
+@dataclass
+class ArgMaxParameter(Message):
+    out_max_val: bool = False
+    top_k: int = 1
+    axis: int | None = None
+
+
+@dataclass
+class EmbedParameter(Message):
+    num_output: int = 0
+    input_dim: int = 0
+    bias_term: bool = True
+    weight_filler: FillerParameter | None = None
+    bias_filler: FillerParameter | None = None
+
+
+@dataclass
+class SPPParameter(Message):
+    pyramid_height: int = 0
+    pool: str = "MAX"
+    engine: str = "DEFAULT"
+
+
+@dataclass
+class RecurrentParameter(Message):
+    num_output: int = 0
+    weight_filler: FillerParameter | None = None
+    bias_filler: FillerParameter | None = None
+    debug_info: bool = False
+    expose_hidden: bool = False
+
+
+@dataclass
+class TransformationParameter(Message):
+    """Data augmentation config (caffe.proto TransformationParameter;
+    applied by the reference's DataTransformer, data_transformer.cpp)."""
+    scale: float = 1.0
+    mirror: bool = False
+    crop_size: int = 0
+    mean_file: str = ""
+    mean_value: list[float] = _rep()
+    force_color: bool = False
+    force_gray: bool = False
+    # NVCaffe extras
+    use_gpu_transform: bool = False
+    random_seed: int = -1
+
+
+@dataclass
+class DataParameter(Message):
+    source: str = ""
+    batch_size: int = 0
+    rand_skip: int = 0
+    backend: str = "LEVELDB"  # LEVELDB / LMDB
+    scale: float = 1.0  # legacy transform fields
+    mean_file: str = ""
+    crop_size: int = 0
+    mirror: bool = False
+    force_encoded_color: bool = False
+    prefetch: int = 4
+    # NVCaffe extras: threads & cache
+    threads: int = 0
+    parser_threads: int = 0
+    cache: bool = False
+    shuffle: bool = False
+
+
+@dataclass
+class ImageDataParameter(Message):
+    source: str = ""
+    batch_size: int = 1
+    rand_skip: int = 0
+    shuffle: bool = False
+    new_height: int = 0
+    new_width: int = 0
+    is_color: bool = True
+    scale: float = 1.0
+    mean_file: str = ""
+    crop_size: int = 0
+    mirror: bool = False
+    root_folder: str = ""
+
+
+@dataclass
+class MemoryDataParameter(Message):
+    batch_size: int = 0
+    channels: int = 0
+    height: int = 0
+    width: int = 0
+
+
+@dataclass
+class HDF5DataParameter(Message):
+    source: str = ""
+    batch_size: int = 0
+    shuffle: bool = False
+
+
+@dataclass
+class HDF5OutputParameter(Message):
+    file_name: str = ""
+
+
+@dataclass
+class WindowDataParameter(Message):
+    source: str = ""
+    scale: float = 1.0
+    mean_file: str = ""
+    batch_size: int = 0
+    crop_size: int = 0
+    mirror: bool = False
+    fg_threshold: float = 0.5
+    bg_threshold: float = 0.5
+    fg_fraction: float = 0.25
+    context_pad: int = 0
+    crop_mode: str = "warp"
+    cache_images: bool = False
+    root_folder: str = ""
+
+
+@dataclass
+class DummyDataParameter(Message):
+    data_filler: list[FillerParameter] = _rep()
+    shape: list[BlobShape] = _rep()
+    num: list[int] = _rep()  # legacy 4D
+    channels: list[int] = _rep()
+    height: list[int] = _rep()
+    width: list[int] = _rep()
+
+
+@dataclass
+class InputParameter(Message):
+    shape: list[BlobShape] = _rep()
+
+
+@dataclass
+class PythonParameter(Message):
+    module: str = ""
+    layer: str = ""
+    param_str: str = ""
+    share_in_parallel: bool = False
+
+
+@dataclass
+class BatchReindexParameter(Message):
+    pass
+
+
+@dataclass
+class FilterParameter(Message):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# LayerParameter
+# ---------------------------------------------------------------------------
+
+@dataclass
+class LayerParameter(Message):
+    """One op instance in the graph (caffe.proto LayerParameter:368-480)."""
+    name: str = ""
+    type: str = ""
+    bottom: list[str] = _rep()
+    top: list[str] = _rep()
+    phase: str = ""
+    loss_weight: list[float] = _rep()
+    param: list[ParamSpec] = _rep()
+    propagate_down: list[bool] = _rep()
+    include: list[NetStateRule] = _rep()
+    exclude: list[NetStateRule] = _rep()
+
+    # NVCaffe per-layer precision selection (caffe.proto:374-382):
+    # FLOAT/FLOAT16/DOUBLE. FLOAT16 maps to bfloat16 on TPU.
+    forward_type: str = ""
+    backward_type: str = ""
+    forward_math: str = ""
+    backward_math: str = ""
+    debug: bool = False
+
+    transform_param: TransformationParameter | None = None
+    loss_param: LossParameter | None = None
+
+    accuracy_param: AccuracyParameter | None = None
+    argmax_param: ArgMaxParameter | None = None
+    batch_norm_param: BatchNormParameter | None = None
+    bias_param: BiasParameter | None = None
+    concat_param: ConcatParameter | None = None
+    contrastive_loss_param: ContrastiveLossParameter | None = None
+    convolution_param: ConvolutionParameter | None = None
+    crop_param: CropParameter | None = None
+    data_param: DataParameter | None = None
+    dropout_param: DropoutParameter | None = None
+    dummy_data_param: DummyDataParameter | None = None
+    eltwise_param: EltwiseParameter | None = None
+    elu_param: ELUParameter | None = None
+    embed_param: EmbedParameter | None = None
+    exp_param: ExpParameter | None = None
+    flatten_param: FlattenParameter | None = None
+    hdf5_data_param: HDF5DataParameter | None = None
+    hdf5_output_param: HDF5OutputParameter | None = None
+    hinge_loss_param: HingeLossParameter | None = None
+    image_data_param: ImageDataParameter | None = None
+    infogain_loss_param: InfogainLossParameter | None = None
+    inner_product_param: InnerProductParameter | None = None
+    input_param: InputParameter | None = None
+    log_param: LogParameter | None = None
+    lrn_param: LRNParameter | None = None
+    memory_data_param: MemoryDataParameter | None = None
+    mvn_param: MVNParameter | None = None
+    pooling_param: PoolingParameter | None = None
+    power_param: PowerParameter | None = None
+    prelu_param: PReLUParameter | None = None
+    python_param: PythonParameter | None = None
+    recurrent_param: RecurrentParameter | None = None
+    reduction_param: ReductionParameter | None = None
+    relu_param: ReLUParameter | None = None
+    reshape_param: ReshapeParameter | None = None
+    scale_param: ScaleParameter | None = None
+    sigmoid_param: SigmoidParameter | None = None
+    slice_param: SliceParameter | None = None
+    softmax_param: SoftmaxParameter | None = None
+    spp_param: SPPParameter | None = None
+    tanh_param: TanHParameter | None = None
+    threshold_param: ThresholdParameter | None = None
+    tile_param: TileParameter | None = None
+    window_data_param: WindowDataParameter | None = None
+
+
+# ---------------------------------------------------------------------------
+# NetParameter
+# ---------------------------------------------------------------------------
+
+@dataclass
+class NetParameter(Message):
+    """Whole-graph definition (caffe.proto NetParameter:88-146)."""
+    name: str = ""
+    input: list[str] = _rep()  # legacy "input"/"input_shape"/"input_dim"
+    input_shape: list[BlobShape] = _rep()
+    input_dim: list[int] = _rep()
+    force_backward: bool = False
+    state: NetState | None = None
+    debug_info: bool = False
+    layer: list[LayerParameter] = _rep()
+    layers: list[LayerParameter] = _rep()  # legacy V1 field name
+
+    # NVCaffe net-wide precision defaults (caffe.proto:124-127)
+    default_forward_type: str = "FLOAT"
+    default_backward_type: str = "FLOAT"
+    default_forward_math: str = ""
+    default_backward_math: str = ""
+    # fp16 loss scaling (caffe.proto:130; applied net.cpp:815-818)
+    global_grad_scale: float = 1.0
+    default_conv_algos_override: str = ""
+    reduce_buckets: int = 6  # accepted; XLA schedules collectives instead
+
+
+# ---------------------------------------------------------------------------
+# SolverParameter
+# ---------------------------------------------------------------------------
+
+@dataclass
+class SolverParameter(Message):
+    """Training configuration (caffe.proto SolverParameter:147-301)."""
+    net: str = ""
+    net_param: NetParameter | None = None
+    train_net: str = ""
+    test_net: list[str] = _rep()
+    train_net_param: NetParameter | None = None
+    test_net_param: list[NetParameter] = _rep()
+    train_state: NetState | None = None
+    test_state: list[NetState] = _rep()
+
+    test_iter: list[int] = _rep()
+    test_interval: int = 0
+    test_compute_loss: bool = False
+    test_initialization: bool = True
+
+    base_lr: float = 0.01
+    display: int = 0
+    average_loss: int = 1
+    max_iter: int = 0
+    iter_size: int = 1
+
+    lr_policy: str = "fixed"
+    gamma: float = 0.0
+    power: float = 0.0
+    momentum: float = 0.0
+    weight_decay: float = 0.0
+    regularization_type: str = "L2"
+    stepsize: int = 0
+    stepvalue: list[int] = _rep()
+    clip_gradients: float = -1.0
+    min_lr: float = 0.0
+
+    # large-batch warmup (NVCaffe caffe.proto:193-195; sgd_solver.cpp:27-33)
+    rampup_interval: int = 0
+    rampup_lr: float = 0.0
+    # momentum policy (caffe.proto:228-230; sgd_solver.cpp:67-91)
+    momentum_policy: str = "fixed"
+    max_momentum: float = 0.0
+    momentum2: float = 0.999
+    rms_decay: float = 0.99
+    delta: float = 1e-8
+
+    snapshot: int = 0
+    snapshot_prefix: str = ""
+    snapshot_diff: bool = False
+    snapshot_format: str = "BINARYPROTO"
+    snapshot_after_train: bool = True
+
+    solver_mode: str = "GPU"
+    device_id: int = 0
+    random_seed: int = -1
+
+    type: str = "SGD"
+    solver_type: Any = ""  # legacy enum: identifier (ADAM) or number (5)
+    debug_info: bool = False
+
+    # fp16 master-weight storage (caffe.proto:299)
+    solver_data_type: str = "FLOAT"
+    # loss scaling for fp16 grads (net-level global_grad_scale mirror)
+    global_grad_scale: float = 1.0
+
+    # data layer hint fields (NVCaffe)
+    min_plateau_lr: float = 0.0
+    plateau_winsize: list[int] = _rep()
+
+    # TPU-native extension: device mesh shape for pjit sharding, replacing
+    # the reference's mpirun/GPU-list topology flags.
+    mesh_data_axis: int = 0
+
+
+SOLVER_TYPE_NAMES = {
+    # legacy solver_type enum value -> modern type string
+    "SGD": "SGD", "NESTEROV": "Nesterov", "ADAGRAD": "AdaGrad",
+    "RMSPROP": "RMSProp", "ADADELTA": "AdaDelta", "ADAM": "Adam",
+    "0": "SGD", "1": "Nesterov", "2": "AdaGrad",
+    "3": "RMSProp", "4": "AdaDelta", "5": "Adam",
+}
+
+
+def solver_type(solver: SolverParameter) -> str:
+    """Resolve modern `type` vs legacy `solver_type` enum
+    (reference: solver_factory upgrade path)."""
+    if solver.has("type") or solver.solver_type == "":
+        return solver.type
+    return SOLVER_TYPE_NAMES.get(str(solver.solver_type).upper(), solver.type)

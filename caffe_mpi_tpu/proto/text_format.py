@@ -1,0 +1,330 @@
+"""Protobuf text-format parser/printer — the prototxt substrate.
+
+The reference framework configures *everything* through protobuf text files
+("prototxt": net definitions, solver definitions; see
+/root/reference/src/caffe/proto/caffe.proto and the readers in
+/root/reference/src/caffe/util/io.cpp). Rather than depending on protoc and a
+compiled schema, this module implements the protobuf *text format* grammar
+generically: a prototxt file parses into an untyped `PbNode` tree
+(field name -> list of scalar values or sub-messages). The typed schema layer
+(`caffe_mpi_tpu.proto.config`) then coerces the tree into dataclasses.
+
+This keeps the config layer pure Python, introspectable, and free of codegen,
+while accepting the reference's own model files unchanged.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Iterator
+
+
+class PrototxtError(ValueError):
+    """Raised on malformed prototxt input, with line/column context."""
+
+
+class PbEnum(str):
+    """A bare identifier value (protobuf enum constant, or true/false).
+
+    Subclasses str so downstream code can compare against e.g. "LMDB"
+    directly; `is_enum` marks that the token was unquoted in the source.
+    """
+
+    __slots__ = ()
+
+
+class PbNode:
+    """An untyped parsed message: ordered multimap of field name -> values.
+
+    Values are scalars (int, float, bool, str, PbEnum) or nested PbNode.
+    Repeated fields accumulate in order of appearance, matching protobuf
+    repeated-field semantics.
+    """
+
+    __slots__ = ("fields",)
+
+    def __init__(self) -> None:
+        self.fields: dict[str, list[Any]] = {}
+
+    # -- mutation ---------------------------------------------------------
+    def add(self, name: str, value: Any) -> None:
+        self.fields.setdefault(name, []).append(value)
+
+    # -- access -----------------------------------------------------------
+    def __contains__(self, name: str) -> bool:
+        return name in self.fields
+
+    def get_list(self, name: str) -> list[Any]:
+        return self.fields.get(name, [])
+
+    def get(self, name: str, default: Any = None) -> Any:
+        """Last-wins scalar access (proto2 semantics for optional fields)."""
+        vals = self.fields.get(name)
+        return vals[-1] if vals else default
+
+    def keys(self) -> Iterator[str]:
+        return iter(self.fields.keys())
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"PbNode({self.fields!r})"
+
+    def to_text(self, indent: int = 0) -> str:
+        """Serialize back to prototxt text."""
+        out: list[str] = []
+        pad = "  " * indent
+        for name, vals in self.fields.items():
+            for v in vals:
+                if isinstance(v, PbNode):
+                    out.append(f"{pad}{name} {{")
+                    out.append(v.to_text(indent + 1))
+                    out.append(f"{pad}}}")
+                else:
+                    out.append(f"{pad}{name}: {_format_scalar(v)}")
+        return "\n".join(s for s in out if s != "")
+
+
+def _format_scalar(v: Any) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, PbEnum):
+        return str(v)
+    if isinstance(v, str):
+        escaped = v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+        return f'"{escaped}"'
+    if isinstance(v, float):
+        if math.isinf(v):
+            return "inf" if v > 0 else "-inf"
+        if math.isnan(v):
+            return "nan"
+        return repr(v)
+    return str(v)
+
+
+# ---------------------------------------------------------------------------
+# Tokenizer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<comment>\#[^\n]*)
+  | (?P<string>"(?:\\.|[^"\\])*"|'(?:\\.|[^'\\])*')
+  | (?P<punct>[{}:\[\],;<>])
+  | (?P<number>
+        [-+]?(?:
+            0[xX][0-9a-fA-F]+
+          | \.\d+(?:[eE][-+]?\d+)?
+          | \d+\.\d*(?:[eE][-+]?\d+)?
+          | \d+(?:[eE][-+]?\d+)?
+        )
+        # signed-only inf/nan: unsigned forms tokenize as identifiers so that
+        # field names like `infogain_loss_param` are not split mid-word
+      | [-+](?:inf(?:inity)?|nan)(?![A-Za-z0-9_.])
+    )
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_.]*)
+    """,
+    re.VERBOSE,
+)
+
+_ESCAPES = {
+    "n": "\n", "t": "\t", "r": "\r", "\\": "\\", '"': '"', "'": "'",
+    "a": "\a", "b": "\b", "f": "\f", "v": "\v",
+}
+
+
+class _Token:
+    __slots__ = ("kind", "text", "line", "col")
+
+    def __init__(self, kind: str, text: str, line: int, col: int):
+        self.kind = kind
+        self.text = text
+        self.line = line
+        self.col = col
+
+
+def _tokenize(text: str) -> list[_Token]:
+    tokens: list[_Token] = []
+    pos, line, line_start = 0, 1, 0
+    n = len(text)
+    while pos < n:
+        m = _TOKEN_RE.match(text, pos)
+        if m is None:
+            col = pos - line_start + 1
+            raise PrototxtError(
+                f"line {line}:{col}: unexpected character {text[pos]!r}"
+            )
+        kind = m.lastgroup
+        tok_text = m.group()
+        if kind not in ("ws", "comment"):
+            tokens.append(_Token(kind, tok_text, line, pos - line_start + 1))
+        nl = tok_text.count("\n")
+        if nl:
+            line += nl
+            line_start = m.start() + tok_text.rindex("\n") + 1
+        pos = m.end()
+    return tokens
+
+
+def _unquote(s: str) -> str:
+    body = s[1:-1]
+    out: list[str] = []
+    i = 0
+    while i < len(body):
+        c = body[i]
+        if c == "\\" and i + 1 < len(body):
+            nxt = body[i + 1]
+            if nxt in _ESCAPES:
+                out.append(_ESCAPES[nxt])
+                i += 2
+                continue
+            if nxt in "01234567":
+                # protobuf octal escape \o, \oo, \ooo (text printer emits
+                # these for non-printable bytes)
+                j = i + 1
+                while j < min(i + 4, len(body)) and body[j] in "01234567":
+                    j += 1
+                out.append(chr(int(body[i + 1 : j], 8)))
+                i = j
+                continue
+            if nxt == "x":
+                j = i + 2
+                while j < min(i + 4, len(body)) and body[j] in "0123456789abcdefABCDEF":
+                    j += 1
+                if j > i + 2:
+                    out.append(chr(int(body[i + 2 : j], 16)))
+                    i = j
+                    continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def _parse_number(text: str) -> int | float:
+    low = text.lstrip("+-").lower()
+    if low.startswith("inf"):
+        return math.inf if not text.startswith("-") else -math.inf
+    if low == "nan":
+        return math.nan
+    if low.startswith("0x"):
+        sign = -1 if text.startswith("-") else 1
+        return sign * int(low, 16)
+    if "." in text or "e" in low:
+        return float(text)
+    return int(text)
+
+
+# ---------------------------------------------------------------------------
+# Parser
+# ---------------------------------------------------------------------------
+
+class _Parser:
+    def __init__(self, tokens: list[_Token]):
+        self.tokens = tokens
+        self.pos = 0
+
+    def peek(self) -> _Token | None:
+        return self.tokens[self.pos] if self.pos < len(self.tokens) else None
+
+    def next(self) -> _Token:
+        tok = self.peek()
+        if tok is None:
+            raise PrototxtError("unexpected end of input")
+        self.pos += 1
+        return tok
+
+    def expect(self, text: str) -> _Token:
+        tok = self.next()
+        if tok.text != text:
+            raise PrototxtError(
+                f"line {tok.line}:{tok.col}: expected {text!r}, got {tok.text!r}"
+            )
+        return tok
+
+    def parse_message(self, terminator: str | None) -> PbNode:
+        node = PbNode()
+        while True:
+            tok = self.peek()
+            if tok is None:
+                if terminator is None:
+                    return node
+                raise PrototxtError(f"unexpected end of input, expected {terminator!r}")
+            if terminator is not None and tok.text == terminator:
+                self.next()
+                return node
+            if tok.text in (";", ","):  # optional field separators
+                self.next()
+                continue
+            self.parse_field(node)
+
+    def parse_field(self, node: PbNode) -> None:
+        name_tok = self.next()
+        if name_tok.kind != "ident":
+            raise PrototxtError(
+                f"line {name_tok.line}:{name_tok.col}: expected field name, "
+                f"got {name_tok.text!r}"
+            )
+        name = name_tok.text
+        tok = self.peek()
+        if tok is None:
+            raise PrototxtError(f"unexpected end of input after field {name!r}")
+        if tok.text == "{" or tok.text == "<":
+            self.next()
+            node.add(name, self.parse_message("}" if tok.text == "{" else ">"))
+            return
+        self.expect(":")
+        tok = self.peek()
+        if tok is not None and (tok.text == "{" or tok.text == "<"):
+            # `name: { ... }` is legal text format for message fields
+            self.next()
+            node.add(name, self.parse_message("}" if tok.text == "{" else ">"))
+            return
+        if tok is not None and tok.text == "[":
+            self.next()
+            while True:
+                t = self.peek()
+                if t is None:
+                    raise PrototxtError("unterminated list")
+                if t.text == "]":
+                    self.next()
+                    break
+                if t.text == ",":
+                    self.next()
+                    continue
+                node.add(name, self.parse_scalar())
+            return
+        node.add(name, self.parse_scalar())
+
+    def parse_scalar(self) -> Any:
+        tok = self.next()
+        if tok.kind == "string":
+            val = _unquote(tok.text)
+            # adjacent string literals concatenate (C-style)
+            while (nxt := self.peek()) is not None and nxt.kind == "string":
+                val += _unquote(self.next().text)
+            return val
+        if tok.kind == "number":
+            return _parse_number(tok.text)
+        if tok.kind == "ident":
+            if tok.text == "true":
+                return True
+            if tok.text == "false":
+                return False
+            if tok.text.lower() in ("inf", "infinity"):
+                return math.inf
+            if tok.text.lower() == "nan":
+                return math.nan
+            return PbEnum(tok.text)
+        raise PrototxtError(
+            f"line {tok.line}:{tok.col}: expected value, got {tok.text!r}"
+        )
+
+
+def parse(text: str) -> PbNode:
+    """Parse prototxt text into an untyped PbNode tree."""
+    return _Parser(_tokenize(text)).parse_message(None)
+
+
+def parse_file(path: str) -> PbNode:
+    with open(path, "r", encoding="utf-8") as f:
+        return parse(f.read())

@@ -1,0 +1,121 @@
+"""Net normalization + phase/stage/level filtering.
+
+Mirrors the reference's legacy-migration and rule-evaluation behavior:
+- `upgrade_proto.cpp` migrates V0/V1 nets on every load; here `normalize_net`
+  folds the legacy `layers:`/`input:`/`input_dim:` fields into the modern
+  `layer:` form and maps V1 ALL-CAPS type enums to modern type names.
+- `net.cpp:407-498` (FilterNet/StateMeetsRule) selects which layers are live
+  for a given NetState (phase/level/stages); `filter_net` reproduces those
+  rules so one prototxt serves train/test/deploy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from .config import (
+    BlobShape,
+    InputParameter,
+    LayerParameter,
+    NetParameter,
+    NetState,
+    NetStateRule,
+)
+
+# V1LayerParameter ALL-CAPS enum -> modern type string
+# (reference upgrade_proto.cpp UpgradeV1LayerType)
+_V1_TYPE_NAMES = {
+    "ABSVAL": "AbsVal", "ACCURACY": "Accuracy", "ARGMAX": "ArgMax",
+    "BNLL": "BNLL", "CONCAT": "Concat", "CONTRASTIVE_LOSS": "ContrastiveLoss",
+    "CONVOLUTION": "Convolution", "DATA": "Data", "DECONVOLUTION": "Deconvolution",
+    "DROPOUT": "Dropout", "DUMMY_DATA": "DummyData",
+    "EUCLIDEAN_LOSS": "EuclideanLoss", "ELTWISE": "Eltwise", "EXP": "Exp",
+    "FLATTEN": "Flatten", "HDF5_DATA": "HDF5Data", "HDF5_OUTPUT": "HDF5Output",
+    "HINGE_LOSS": "HingeLoss", "IM2COL": "Im2col", "IMAGE_DATA": "ImageData",
+    "INFOGAIN_LOSS": "InfogainLoss", "INNER_PRODUCT": "InnerProduct",
+    "LRN": "LRN", "MEMORY_DATA": "MemoryData",
+    "MULTINOMIAL_LOGISTIC_LOSS": "MultinomialLogisticLoss", "MVN": "MVN",
+    "POOLING": "Pooling", "POWER": "Power", "RELU": "ReLU",
+    "SIGMOID": "Sigmoid", "SIGMOID_CROSS_ENTROPY_LOSS": "SigmoidCrossEntropyLoss",
+    "SILENCE": "Silence", "SOFTMAX": "Softmax", "SOFTMAX_LOSS": "SoftmaxWithLoss",
+    "SPLIT": "Split", "SLICE": "Slice", "TANH": "TanH",
+    "WINDOW_DATA": "WindowData", "THRESHOLD": "Threshold",
+}
+
+
+def normalize_net(net: NetParameter) -> NetParameter:
+    """Fold legacy fields into modern form, in place; returns the net."""
+    if net.layers and net.layer:
+        raise ValueError(
+            "net mixes legacy 'layers' and modern 'layer' fields; migrate "
+            "the legacy entries (reference upgrade_proto.cpp errors here too)"
+        )
+    if net.layers:
+        net.layer = net.layers
+        net.layers = []
+    for lp in net.layer:
+        if lp.type in _V1_TYPE_NAMES:
+            lp.type = _V1_TYPE_NAMES[lp.type]
+    # Legacy net-level inputs -> synthetic Input layer at the front
+    # (reference upgrade_proto.cpp UpgradeNetInput).
+    if net.input:
+        shapes: list[BlobShape] = []
+        if net.input_shape:
+            shapes = list(net.input_shape)
+        elif net.input_dim:
+            if len(net.input_dim) != 4 * len(net.input):
+                raise ValueError(
+                    f"input_dim count {len(net.input_dim)} != 4 * inputs"
+                )
+            for i in range(len(net.input)):
+                shape = BlobShape()
+                shape.dim = list(net.input_dim[4 * i : 4 * i + 4])
+                shapes.append(shape)
+        if len(shapes) not in (0, len(net.input)):
+            raise ValueError("input_shape count must match input count")
+        lp = LayerParameter(name="input", type="Input", top=list(net.input))
+        lp.input_param = InputParameter(shape=shapes)
+        net.layer.insert(0, lp)
+        net.input, net.input_shape, net.input_dim = [], [], []
+    return net
+
+
+def state_meets_rule(state: NetState, rule: NetStateRule) -> bool:
+    """Reference Net::StateMeetsRule (net.cpp:461-498)."""
+    if rule.has("phase") and rule.phase != state.phase:
+        return False
+    if rule.has("min_level") and state.level < rule.min_level:
+        return False
+    if rule.has("max_level") and state.level > rule.max_level:
+        return False
+    for stage in rule.stage:
+        if stage not in state.stage:
+            return False
+    for stage in rule.not_stage:
+        if stage in state.stage:
+            return False
+    return True
+
+
+def layer_included(lp: LayerParameter, state: NetState) -> bool:
+    """Reference Net::FilterNet (net.cpp:407-433): a layer with `include`
+    rules is in iff some rule matches; otherwise it is in unless some
+    `exclude` rule matches. The layer's own `phase` field is NOT a filter —
+    the reference inherits/uses it post-filtering (net.cpp:125-127)."""
+    if lp.include and lp.exclude:
+        raise ValueError(
+            f"layer {lp.name!r}: specify include or exclude rules, not both"
+        )
+    if lp.include:
+        return any(state_meets_rule(state, r) for r in lp.include)
+    return not any(state_meets_rule(state, r) for r in lp.exclude)
+
+
+def filter_net(net: NetParameter, state: NetState) -> NetParameter:
+    """Return a shallow-copied net containing only layers live under `state`."""
+    filtered = dataclasses.replace(net)
+    filtered.layer = [lp for lp in net.layer if layer_included(lp, state)]
+    if hasattr(net, "_node"):
+        filtered._node = net._node  # preserve presence info
+        filtered._unknown = getattr(net, "_unknown", [])
+    return filtered

@@ -1,0 +1,25 @@
+"""Test harness: run JAX on a virtual 8-device CPU platform.
+
+The reference tests multi-GPU data parallelism with a real in-process
+P2PManager over k GPUs (test_gradient_based_solver.cpp:201-217) and leaves
+multi-node untested. Here the same gap is closed portably: XLA's host
+platform is split into 8 virtual devices so mesh/psum/pjit paths run as a
+real 8-way SPMD program on CPU.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(1701)

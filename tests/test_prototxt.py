@@ -1,0 +1,239 @@
+"""Config-layer tests: text-format parsing, schema coercion, net filtering.
+
+Mirrors the coverage of the reference's test_upgrade_proto.cpp and the
+net-filtering parts of test_net.cpp.
+"""
+
+import math
+
+import pytest
+
+from caffe_mpi_tpu.proto import (
+    NetParameter,
+    NetState,
+    PrototxtError,
+    SolverParameter,
+    filter_net,
+    normalize_net,
+    parse,
+    solver_type,
+)
+
+
+LENET = """
+name: "LeNet"
+layer {
+  name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 64 dim: 1 dim: 28 dim: 28 } }
+}
+layer {
+  name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  param { lr_mult: 1 } param { lr_mult: 2 }
+  convolution_param {
+    num_output: 20 kernel_size: 5 stride: 1
+    weight_filler { type: "xavier" }
+    bias_filler { type: "constant" }
+  }
+}
+layer {
+  name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer {
+  name: "ip1" type: "InnerProduct" bottom: "pool1" top: "ip1"
+  inner_product_param { num_output: 500 weight_filler { type: "xavier" } }
+}
+layer { name: "relu1" type: "ReLU" bottom: "ip1" top: "ip1" }
+layer {
+  name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+  inner_product_param { num_output: 10 weight_filler { type: "xavier" } }
+}
+layer {
+  name: "loss" type: "SoftmaxWithLoss" bottom: "ip2" bottom: "label" top: "loss"
+  include { phase: TRAIN }
+}
+layer {
+  name: "accuracy" type: "Accuracy" bottom: "ip2" bottom: "label" top: "accuracy"
+  include { phase: TEST }
+}
+"""
+
+
+class TestTextFormat:
+    def test_scalars(self):
+        node = parse('a: 1 b: -2.5 c: 1e-3 d: true e: false f: "hi" g: FOO h: 0x10')
+        assert node.get("a") == 1
+        assert node.get("b") == -2.5
+        assert node.get("c") == pytest.approx(1e-3)
+        assert node.get("d") is True
+        assert node.get("e") is False
+        assert node.get("f") == "hi"
+        assert node.get("g") == "FOO"
+        assert node.get("h") == 16
+
+    def test_inf_nan(self):
+        node = parse("a: inf b: -inf c: nan")
+        assert node.get("a") == math.inf
+        assert node.get("b") == -math.inf
+        assert math.isnan(node.get("c"))
+
+    def test_string_escapes_and_concat(self):
+        node = parse(r'''s: "a\n\"b\"" t: "one" "two" u: 'sq'
+        ''')
+        assert node.get("s") == 'a\n"b"'
+        assert node.get("t") == "onetwo"
+        assert node.get("u") == "sq"
+
+    def test_inf_prefixed_identifiers(self):
+        # field names starting with inf/nan must not split mid-word
+        node = parse('infogain_loss_param { source: "m.binaryproto" } nano: 3')
+        assert node.get("infogain_loss_param").get("source") == "m.binaryproto"
+        assert node.get("nano") == 3
+
+    def test_octal_and_hex_escapes(self):
+        node = parse(r's: "\101\102\x43\0"')
+        assert node.get("s") == "ABC\0"
+
+    def test_comments(self):
+        node = parse("# header\na: 1 # trailing\nb: 2")
+        assert node.get("a") == 1 and node.get("b") == 2
+
+    def test_repeated_and_lists(self):
+        node = parse("dim: 1 dim: 2 dim: 3 xs: [4, 5, 6]")
+        assert node.get_list("dim") == [1, 2, 3]
+        assert node.get_list("xs") == [4, 5, 6]
+
+    def test_nested_and_colon_brace(self):
+        node = parse("m { x: 1 } n: { y: 2 } o < z: 3 >")
+        assert node.get("m").get("x") == 1
+        assert node.get("n").get("y") == 2
+        assert node.get("o").get("z") == 3
+
+    def test_errors(self):
+        with pytest.raises(PrototxtError):
+            parse("a: ")
+        with pytest.raises(PrototxtError):
+            parse("a { b: 1")
+        with pytest.raises(PrototxtError):
+            parse("{ }")
+
+    def test_roundtrip(self):
+        node = parse(LENET)
+        again = parse(node.to_text())
+        assert again.to_text() == node.to_text()
+        assert len(again.get_list("layer")) == 8
+
+
+class TestSchema:
+    def test_lenet_coercion(self):
+        net = NetParameter.from_text(LENET)
+        assert net.name == "LeNet"
+        assert len(net.layer) == 8
+        conv = net.layer[1]
+        assert conv.type == "Convolution"
+        assert conv.convolution_param.num_output == 20
+        assert conv.convolution_param.kernel_size == [5]
+        assert conv.convolution_param.weight_filler.type == "xavier"
+        assert [p.lr_mult for p in conv.param] == [1.0, 2.0]
+        pool = net.layer[2]
+        assert pool.pooling_param.pool == "MAX"
+        assert pool.pooling_param.kernel_size == 2
+
+    def test_unknown_fields_tolerated(self):
+        net = NetParameter.from_text('name: "x" frobnicate: 7 layer { type: "ReLU" }')
+        assert net.name == "x"
+        assert "frobnicate" in net.unknown_fields
+
+    def test_presence(self):
+        net = NetParameter.from_text('name: "x"')
+        assert net.has("name") and not net.has("force_backward")
+
+    def test_solver(self):
+        sp = SolverParameter.from_text(
+            """
+            net: "train.prototxt"
+            base_lr: 0.01 momentum: 0.9 weight_decay: 0.0005
+            lr_policy: "inv" gamma: 0.0001 power: 0.75
+            max_iter: 10000 snapshot: 5000 snapshot_prefix: "lenet"
+            test_iter: 100 test_interval: 500
+            solver_mode: GPU type: "SGD"
+            """
+        )
+        assert sp.base_lr == pytest.approx(0.01)
+        assert sp.lr_policy == "inv"
+        assert sp.test_iter == [100]
+        assert solver_type(sp) == "SGD"
+
+    def test_legacy_solver_type_enum(self):
+        sp = SolverParameter.from_text("solver_type: ADAM")
+        assert solver_type(sp) == "Adam"
+        sp2 = SolverParameter.from_text("solver_type: 1")
+        assert solver_type(sp2) == "Nesterov"
+
+    def test_mixed_precision_fields(self):
+        net = NetParameter.from_text(
+            'default_forward_type: FLOAT16 default_backward_type: FLOAT16\n'
+            'global_grad_scale: 1000\n'
+            'layer { name: "c" type: "Convolution" forward_type: FLOAT }'
+        )
+        assert net.default_forward_type == "FLOAT16"
+        assert net.global_grad_scale == 1000
+        assert net.layer[0].forward_type == "FLOAT"
+
+
+class TestFiltering:
+    def test_phase_rules(self):
+        net = normalize_net(NetParameter.from_text(LENET))
+        train = filter_net(net, NetState(phase="TRAIN"))
+        test = filter_net(net, NetState(phase="TEST"))
+        train_names = [l.name for l in train.layer]
+        test_names = [l.name for l in test.layer]
+        assert "loss" in train_names and "accuracy" not in train_names
+        assert "accuracy" in test_names and "loss" not in test_names
+
+    def test_stage_and_level(self):
+        net = NetParameter.from_text(
+            """
+            layer { name: "a" type: "ReLU" include { stage: "deploy" } }
+            layer { name: "b" type: "ReLU" exclude { stage: "deploy" } }
+            layer { name: "c" type: "ReLU" include { min_level: 1 } }
+            layer { name: "d" type: "ReLU" }
+            """
+        )
+        st = NetState(phase="TEST", stage=["deploy"], level=0)
+        names = [l.name for l in filter_net(net, st).layer]
+        assert names == ["a", "d"]
+        st2 = NetState(phase="TEST", level=2)
+        names2 = [l.name for l in filter_net(net, st2).layer]
+        assert names2 == ["b", "c", "d"]
+
+    def test_phase_field_is_not_a_filter(self):
+        # reference net.cpp:125-127: layer `phase` is inherited, not a rule
+        net = NetParameter.from_text(
+            'layer { name: "a" type: "ReLU" phase: TRAIN exclude { stage: "x" } }'
+        )
+        assert [l.name for l in filter_net(net, NetState(phase="TEST")).layer] == ["a"]
+        st = NetState(phase="TRAIN", stage=["x"])
+        assert filter_net(net, st).layer == []
+
+    def test_mixed_legacy_modern_layers_rejected(self):
+        with pytest.raises(ValueError, match="legacy"):
+            normalize_net(
+                NetParameter.from_text(
+                    'layers { name: "old" type: RELU } layer { name: "new" type: "ReLU" }'
+                )
+            )
+
+    def test_legacy_upgrade(self):
+        net = normalize_net(
+            NetParameter.from_text(
+                """
+                input: "data"
+                input_dim: 1 input_dim: 3 input_dim: 4 input_dim: 4
+                layers { name: "conv" type: CONVOLUTION bottom: "data" top: "conv" }
+                """
+            )
+        )
+        assert net.layer[0].type == "Input"
+        assert net.layer[0].input_param.shape[0].dim == [1, 3, 4, 4]
+        assert net.layer[1].type == "Convolution"

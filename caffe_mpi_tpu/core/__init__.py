@@ -1,0 +1,2 @@
+from .types import DtypePolicy, dtype_for
+from .fillers import fill

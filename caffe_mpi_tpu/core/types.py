@@ -1,0 +1,62 @@
+"""Dtype policy — the TPU mapping of the reference's mixed-precision system.
+
+The reference threads a 5-value `Type` enum (DOUBLE/FLOAT/FLOAT16/INT/UINT,
+caffe.proto:6-12) through a per-type `SyncedMemory` projection map inside
+`Tensor` (include/caffe/tensor.hpp:18-106), letting each layer pick
+forward/backward storage and math precision (caffe.proto:374-382).
+
+On TPU there is no manual memory tiering — `jax.Array` lives in HBM and XLA
+manages residency — so the whole Tensor/SyncedMemory machinery collapses to a
+*dtype policy*: which jnp dtype each layer computes in, and which dtype
+parameters are stored in (master weights). FLOAT16 requests map to bfloat16,
+the TPU-native 16-bit format (same exponent range as fp32, so the reference's
+loss-scaling support becomes optional rather than required).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+# Caffe Type enum name -> jnp dtype. DOUBLE maps to float32: TPU has no f64
+# MXU path, and the reference uses DOUBLE only for debugging precision.
+_NAME_TO_DTYPE = {
+    "DOUBLE": jnp.float64,
+    "FLOAT": jnp.float32,
+    "FLOAT16": jnp.bfloat16,
+    "INT": jnp.int32,
+    "UINT": jnp.uint32,
+}
+
+
+def dtype_for(type_name: str, default: jnp.dtype = jnp.float32):
+    if not type_name:
+        return default
+    try:
+        return _NAME_TO_DTYPE[type_name]
+    except KeyError:
+        raise ValueError(f"unknown Type name {type_name!r}") from None
+
+
+@dataclass(frozen=True)
+class DtypePolicy:
+    """Per-layer precision choice, resolved from layer + net defaults the way
+    reference net.cpp:100-156 resolves forward_type/backward_type."""
+
+    forward: jnp.dtype = jnp.float32   # activation compute dtype
+    backward: jnp.dtype = jnp.float32  # gradient compute dtype
+    master: jnp.dtype = jnp.float32    # parameter storage dtype
+
+    @classmethod
+    def resolve(cls, layer_fwd: str, layer_bwd: str, net_fwd: str, net_bwd: str,
+                solver_storage: str = "FLOAT") -> "DtypePolicy":
+        fwd = dtype_for(layer_fwd or net_fwd)
+        bwd = dtype_for(layer_bwd or net_bwd)
+        return cls(forward=fwd, backward=bwd, master=dtype_for(solver_storage))
+
+    def cast_in(self, x):
+        """Cast an input/param to the forward compute dtype (no-op for ints)."""
+        if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != self.forward:
+            return x.astype(self.forward)
+        return x

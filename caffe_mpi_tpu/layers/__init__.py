@@ -1,0 +1,10 @@
+"""Layer registry — importing this package registers all built-in layers."""
+
+from .base import LAYER_REGISTRY, Layer, ParamDecl, create_layer, register, registered_types
+from . import activations  # noqa: F401
+from . import data_layers  # noqa: F401
+from . import dense  # noqa: F401
+from . import losses  # noqa: F401
+from . import norm  # noqa: F401
+from . import shape_ops  # noqa: F401
+from . import vision  # noqa: F401

@@ -1,0 +1,165 @@
+"""Elementwise activation layers.
+
+Reference: src/caffe/layers/{relu,prelu,elu,sigmoid,tanh,bnll,power,exp,log,
+absval,threshold,dropout}_layer.{cpp,cu} (+ cudnn_{relu,sigmoid,tanh,dropout}
+variants). Each reference file is a pair of hand-written CUDA kernels; here
+each is one jnp expression fused by XLA into adjacent ops — the cuDNN
+activation descriptors have no TPU analogue and are dropped.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .base import Layer, Shape, register
+
+
+class _Elementwise(Layer):
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        self._setup_params(in_shapes)
+        return [in_shapes[0]]
+
+    def _setup_params(self, in_shapes) -> None:
+        pass
+
+
+@register("ReLU")
+class ReLULayer(_Elementwise):
+    def apply(self, params, state, bottoms, *, train, rng):
+        x = self.f(bottoms[0])
+        slope = self.lp.relu_param.negative_slope if self.lp.relu_param else 0.0
+        if slope:
+            y = jnp.where(x > 0, x, slope * x)
+        else:
+            y = jnp.maximum(x, 0)
+        return [y], state
+
+
+@register("PReLU")
+class PReLULayer(_Elementwise):
+    def _setup_params(self, in_shapes):
+        p = self.lp.prelu_param
+        channels = in_shapes[0][1] if len(in_shapes[0]) > 1 else 1
+        shared = bool(p and p.channel_shared)
+        self.channels = 1 if shared else channels
+        from ..proto.config import FillerParameter
+        filler = (p.filler if p else None) or FillerParameter(type="constant",
+                                                              value=0.25)
+        self.declare("slope", (self.channels,), filler)
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        x = self.f(bottoms[0])
+        slope = self.f(params["slope"])
+        shape = [1] * x.ndim
+        if self.channels > 1:
+            shape[1] = self.channels
+        slope = slope.reshape(shape)
+        return [jnp.where(x > 0, x, slope * x)], state
+
+
+@register("ELU")
+class ELULayer(_Elementwise):
+    def apply(self, params, state, bottoms, *, train, rng):
+        x = self.f(bottoms[0])
+        alpha = self.lp.elu_param.alpha if self.lp.elu_param else 1.0
+        return [jnp.where(x > 0, x, alpha * (jnp.exp(jnp.minimum(x, 0)) - 1))], state
+
+
+@register("Sigmoid")
+class SigmoidLayer(_Elementwise):
+    def apply(self, params, state, bottoms, *, train, rng):
+        return [jax.nn.sigmoid(self.f(bottoms[0]))], state
+
+
+@register("TanH")
+class TanHLayer(_Elementwise):
+    def apply(self, params, state, bottoms, *, train, rng):
+        return [jnp.tanh(self.f(bottoms[0]))], state
+
+
+@register("BNLL")
+class BNLLLayer(_Elementwise):
+    """y = log(1 + exp(x)), computed stably (bnll_layer.cpp)."""
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        x = self.f(bottoms[0])
+        return [jnp.logaddexp(x, 0.0).astype(x.dtype)], state
+
+
+@register("Power")
+class PowerLayer(_Elementwise):
+    """y = (shift + scale*x)^power (power_layer.cpp)."""
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        p = self.lp.power_param
+        power, scale, shift = (p.power, p.scale, p.shift) if p else (1.0, 1.0, 0.0)
+        x = self.f(bottoms[0])
+        base = shift + scale * x
+        if power == 1.0:
+            return [base], state
+        return [jnp.power(base, power)], state
+
+
+@register("Exp")
+class ExpLayer(_Elementwise):
+    """y = base^(shift + scale*x); base=-1 means e (exp_layer.cpp)."""
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        p = self.lp.exp_param
+        base, scale, shift = (p.base, p.scale, p.shift) if p else (-1.0, 1.0, 0.0)
+        x = self.f(bottoms[0])
+        inner = shift + scale * x
+        if base == -1.0:
+            return [jnp.exp(inner)], state
+        return [jnp.exp(inner * math.log(base))], state
+
+
+@register("Log")
+class LogLayer(_Elementwise):
+    """y = log_base(shift + scale*x); base=-1 means e (log_layer.cpp)."""
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        p = self.lp.log_param
+        base, scale, shift = (p.base, p.scale, p.shift) if p else (-1.0, 1.0, 0.0)
+        x = self.f(bottoms[0])
+        y = jnp.log(shift + scale * x)
+        if base != -1.0:
+            y = y / math.log(base)
+        return [y], state
+
+
+@register("AbsVal")
+class AbsValLayer(_Elementwise):
+    def apply(self, params, state, bottoms, *, train, rng):
+        return [jnp.abs(self.f(bottoms[0]))], state
+
+
+@register("Threshold")
+class ThresholdLayer(_Elementwise):
+    """y = (x > t) ? 1 : 0 — no gradient (threshold_layer.cpp)."""
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        t = self.lp.threshold_param.threshold if self.lp.threshold_param else 0.0
+        x = self.f(bottoms[0])
+        return [jax.lax.stop_gradient((x > t).astype(x.dtype))], state
+
+
+@register("Dropout")
+class DropoutLayer(_Elementwise):
+    """Inverted dropout: train-time y = x*mask/(1-ratio), test-time identity
+    (dropout_layer.cpp — the reference also uses the scale-at-train scheme)."""
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        x = self.f(bottoms[0])
+        if not train:
+            return [x], state
+        ratio = (self.lp.dropout_param.dropout_ratio
+                 if self.lp.dropout_param else 0.5)
+        if rng is None:
+            raise ValueError(f"dropout layer {self.name!r} needs an rng in train mode")
+        keep = 1.0 - ratio
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return [jnp.where(mask, x / keep, 0).astype(x.dtype)], state

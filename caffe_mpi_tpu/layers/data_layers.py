@@ -1,0 +1,150 @@
+"""Graph-input layers: Input, DummyData, MemoryData, Data, ImageData, HDF5Data.
+
+Reference: src/caffe/layers/{input,dummy_data,memory_data,data,image_data,
+hdf5_data}_layer.{cpp,cu} + the DataReader/prefetch machinery (§2.5 of
+SURVEY.md). In the functional design the net is a pure function of its
+inputs, so data layers do not *produce* data inside the graph — they declare
+input shapes, and the host-side pipeline (caffe_mpi_tpu.data) feeds batches
+in as arguments. DummyData is the exception: its constant fill happens
+in-graph (it's shape-static), matching the reference's use of it for tests
+and benchmarks.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.fillers import fill
+from ..proto.config import FillerParameter
+from .base import Layer, Shape, register
+import jax
+
+
+class InputLayerBase(Layer):
+    """Marker base: tops come from the feed dict, not from bottoms."""
+
+    is_input = True
+
+    def feed_shapes(self) -> list[Shape]:
+        return self.out_shapes
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        # bottoms here are the fed arrays, passed through (cast to policy)
+        return [self.f(b) if jnp.issubdtype(b.dtype, jnp.floating) else b
+                for b in bottoms], state
+
+
+@register("Input")
+class InputLayer(InputLayerBase):
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        p = self.lp.input_param
+        if not p or not p.shape:
+            raise ValueError(f"{self.name}: input_param.shape required")
+        shapes = [tuple(s.dim) for s in p.shape]
+        if len(shapes) == 1 and len(self.lp.top) > 1:
+            shapes = shapes * len(self.lp.top)
+        return shapes
+
+
+@register("DummyData")
+class DummyDataLayer(Layer):
+    """Constant/filled tops, generated in-graph (dummy_data_layer.cpp)."""
+
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        p = self.lp.dummy_data_param
+        if p.shape:
+            shapes = [tuple(s.dim) for s in p.shape]
+        else:  # legacy num/channels/height/width
+            shapes = [
+                (p.num[i], p.channels[i], p.height[i], p.width[i])
+                for i in range(len(p.num))
+            ]
+        if len(shapes) == 1:
+            shapes = shapes * len(self.lp.top)
+        self.fillers = list(p.data_filler) or [FillerParameter(type="constant")]
+        if len(self.fillers) == 1:
+            self.fillers = self.fillers * len(shapes)
+        return shapes
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        key = rng if rng is not None else jax.random.PRNGKey(0)
+        tops = []
+        for i, (shape, filler) in enumerate(zip(self.out_shapes, self.fillers)):
+            tops.append(fill(filler, jax.random.fold_in(key, i), shape,
+                             self.policy.forward))
+        return tops, state
+
+
+@register("MemoryData")
+class MemoryDataLayer(InputLayerBase):
+    """In the reference, user code Reset()s a pointer to host memory
+    (memory_data_layer.cpp); here it is just a typed feed slot."""
+
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        p = self.lp.memory_data_param
+        return [
+            (p.batch_size, p.channels, p.height, p.width),
+            (p.batch_size,),
+        ][: len(self.lp.top)]
+
+
+class PipelineDataLayer(InputLayerBase):
+    """Base for DB-backed layers (Data/ImageData/HDF5Data/WindowData): the
+    host-side reader (caffe_mpi_tpu.data) produces batches; in-graph they are
+    feed slots shaped from transform_param + batch size."""
+
+    def _data_shapes(self, batch: int, channels: int, height: int, width: int):
+        tp = self.lp.transform_param
+        if tp and tp.crop_size:
+            height = width = tp.crop_size
+        shapes = [(batch, channels, height, width)]
+        if len(self.lp.top) > 1:
+            shapes.append((batch,))
+        return shapes
+
+
+@register("Data")
+class DataLayer(PipelineDataLayer):
+    """LMDB/LevelDB-backed (data_layer.cpp). Shape comes from the dataset at
+    pipeline bind time; setup uses declared/transform dims with a dataset
+    probe done by the runner (set via `bind_shape`)."""
+
+    bound_shape: tuple | None = None
+
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        p = self.lp.data_param
+        if self.bound_shape is None:
+            raise ValueError(
+                f"{self.name}: Data layer requires a dataset probe; the "
+                "runner must set layer.bound_shape = (C, H, W) before setup"
+            )
+        c, h, w = self.bound_shape
+        return self._data_shapes(p.batch_size, c, h, w)
+
+
+@register("ImageData")
+class ImageDataLayer(PipelineDataLayer):
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        p = self.lp.image_data_param
+        c = 3 if p.is_color else 1
+        h, w = p.new_height, p.new_width
+        if not (h and w):
+            raise ValueError(
+                f"{self.name}: ImageData requires new_height/new_width for "
+                "static shapes"
+            )
+        return self._data_shapes(p.batch_size, c, h, w)
+
+
+@register("HDF5Data")
+class HDF5DataLayer(InputLayerBase):
+    bound_shapes: list[tuple] | None = None
+
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        if self.bound_shapes is None:
+            raise ValueError(
+                f"{self.name}: runner must probe the HDF5 source and set "
+                "layer.bound_shapes before setup"
+            )
+        batch = self.lp.hdf5_data_param.batch_size
+        return [(batch, *s) for s in self.bound_shapes]

@@ -1,0 +1,134 @@
+"""Dense layers: InnerProduct, Embed, Bias, Scale.
+
+Reference: src/caffe/layers/{inner_product,embed,bias,scale}_layer.{cpp,cu}.
+InnerProduct's cuBLAS gemm calls become a single jnp.dot lowered onto the
+MXU; Bias/Scale broadcast arithmetic is fused by XLA into neighboring ops.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ..proto.config import FillerParameter
+from .base import Layer, Shape, register
+
+
+@register("InnerProduct")
+class InnerProductLayer(Layer):
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        p = self.lp.inner_product_param
+        self.p = p
+        self.axis = p.axis % len(in_shapes[0]) if p.axis < 0 else p.axis
+        k = math.prod(in_shapes[0][self.axis:])
+        self.k = k
+        # Caffe stores (num_output, K), or (K, num_output) when transpose
+        wshape = (k, p.num_output) if p.transpose else (p.num_output, k)
+        self.declare("weight", wshape, p.weight_filler)
+        if p.bias_term:
+            self.declare("bias", (p.num_output,),
+                         p.bias_filler or FillerParameter(type="constant"))
+        return [(*in_shapes[0][: self.axis], p.num_output)]
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        x = self.f(bottoms[0])
+        lead = x.shape[: self.axis]
+        x2 = x.reshape(math.prod(lead) if lead else 1, self.k)
+        w = self.f(params["weight"])
+        y = x2 @ (w if self.p.transpose else w.T)
+        if self.p.bias_term:
+            y = y + self.f(params["bias"])
+        return [y.reshape(*lead, self.p.num_output)], state
+
+
+@register("Embed")
+class EmbedLayer(Layer):
+    """Index lookup as one-hot matmul in the reference (embed_layer.cu);
+    here a plain take() gather."""
+
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        p = self.lp.embed_param
+        self.p = p
+        self.declare("weight", (p.input_dim, p.num_output), p.weight_filler)
+        if p.bias_term:
+            self.declare("bias", (p.num_output,),
+                         p.bias_filler or FillerParameter(type="constant"))
+        return [(*in_shapes[0], p.num_output)]
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        idx = bottoms[0].astype(jnp.int32)
+        y = jnp.take(self.f(params["weight"]), idx, axis=0)
+        if self.p.bias_term:
+            y = y + self.f(params["bias"])
+        return [y], state
+
+
+def _broadcast_along(vec: jnp.ndarray, nd: int, axis: int) -> jnp.ndarray:
+    """Reshape a (num_axes...)-shaped param so it broadcasts against an
+    nd-dim input starting at `axis` (scale_layer.cpp multicast logic)."""
+    shape = [1] * nd
+    for i, s in enumerate(vec.shape):
+        shape[axis + i] = s
+    return vec.reshape(shape)
+
+
+class _ScaleBiasBase(Layer):
+    """Shared logic: param shape = bottom shape[axis : axis+num_axes], or the
+    second bottom provides the operand."""
+
+    def _setup(self, in_shapes, axis: int, num_axes: int, filler, default_fill):
+        self.two_bottom = len(in_shapes) > 1
+        nd = len(in_shapes[0])
+        self.axis = axis % nd if axis < 0 else axis
+        if self.two_bottom:
+            self.op_shape = in_shapes[1]
+        else:
+            if num_axes == -1:
+                self.op_shape = in_shapes[0][self.axis:]
+            else:
+                self.op_shape = in_shapes[0][self.axis : self.axis + num_axes]
+            self.declare("operand", tuple(self.op_shape),
+                         filler or FillerParameter(type="constant", value=default_fill))
+        return [in_shapes[0]]
+
+    def _operand(self, params, bottoms, nd):
+        if self.two_bottom:
+            return _broadcast_along(self.f(bottoms[1]), nd, self.axis)
+        return _broadcast_along(self.f(params["operand"]), nd, self.axis)
+
+
+@register("Scale")
+class ScaleLayer(_ScaleBiasBase):
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        p = self.lp.scale_param
+        self.p = p
+        out = self._setup(in_shapes, p.axis if p else 1,
+                          p.num_axes if p else 1,
+                          p.filler if p else None, default_fill=1.0)
+        self.bias_term = bool(p and p.bias_term)
+        if self.bias_term:
+            self.declare("bias", tuple(self.op_shape),
+                         (p.bias_filler if p else None)
+                         or FillerParameter(type="constant"))
+        return out
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        x = self.f(bottoms[0])
+        y = x * self._operand(params, bottoms, x.ndim)
+        if self.bias_term:
+            y = y + _broadcast_along(self.f(params["bias"]), x.ndim, self.axis)
+        return [y], state
+
+
+@register("Bias")
+class BiasLayer(_ScaleBiasBase):
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        p = self.lp.bias_param
+        return self._setup(in_shapes, p.axis if p else 1,
+                           p.num_axes if p else 1,
+                           p.filler if p else None, default_fill=0.0)
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        x = self.f(bottoms[0])
+        return [x + self._operand(params, bottoms, x.ndim)], state

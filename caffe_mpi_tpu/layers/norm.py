@@ -1,0 +1,99 @@
+"""Normalization layers: BatchNorm, MVN.
+
+Reference: src/caffe/layers/batch_norm_layer.cpp (+cudnn variant), mvn_layer.cpp.
+
+NVCaffe BatchNorm stores blobs [mean(C), var(C), correction(1), scale(C)?,
+bias(C)?] (batch_norm_layer.cpp:39-60) with EMA
+`global = (1-f)*batch + f*global` (batch_norm_layer.cpp:201-206), biased batch
+variance, and eps clamped to >= 1e-5. Running statistics are non-learnable, so
+here they live in the layer *state* pytree (updated functionally each training
+step) while scale/bias are ordinary params; the classic BVLC pattern
+BatchNorm+Scale appears as two layers and works the same way.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..proto.config import BatchNormParameter, FillerParameter
+from .base import Layer, Shape, register
+
+
+@register("BatchNorm")
+class BatchNormLayer(Layer):
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        p = self.lp.batch_norm_param or BatchNormParameter()
+        self.p = p
+        self.channels = in_shapes[0][1] if len(in_shapes[0]) > 1 else 1
+        self.eps = max(p.eps, 1e-5)
+        # scale_bias implicit-on when a filler is given (batch_norm_layer.cpp:28-30)
+        self.scale_bias = p.scale_bias or p.has("scale_filler") or p.has("bias_filler")
+        if self.scale_bias:
+            self.declare("scale", (self.channels,),
+                         p.scale_filler or FillerParameter(type="constant", value=1.0))
+            self.declare("bias", (self.channels,),
+                         p.bias_filler or FillerParameter(type="constant", value=0.0))
+        # use_global_stats: explicit setting wins; else phase decides
+        if p.has("use_global_stats"):
+            self.use_global = p.use_global_stats
+        else:
+            self.use_global = self.phase == "TEST"
+        self.reduce_axes = None  # set in apply from ndim
+        return [in_shapes[0]]
+
+    def init_state(self):
+        return {
+            "mean": jnp.zeros((self.channels,), jnp.float32),
+            "var": jnp.zeros((self.channels,), jnp.float32),
+        }
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        x = self.f(bottoms[0])
+        nd = x.ndim
+        axes = tuple(i for i in range(nd) if i != 1)
+        shape = [1] * nd
+        shape[1] = self.channels
+        use_global = self.use_global or not train
+        if use_global:
+            mean = state["mean"]
+            var = state["var"]
+            new_state = state
+        else:
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.mean(jnp.square(xf - mean.reshape(shape)), axis=axes)
+            f = self.p.moving_average_fraction
+            new_state = {
+                "mean": (1.0 - f) * mean + f * state["mean"],
+                "var": (1.0 - f) * var + f * state["var"],
+            }
+        inv_std = 1.0 / jnp.sqrt(var + self.eps)
+        y = (x - mean.reshape(shape).astype(x.dtype)) * inv_std.reshape(shape).astype(x.dtype)
+        if self.scale_bias:
+            y = y * self.f(params["scale"]).reshape(shape)
+            y = y + self.f(params["bias"]).reshape(shape)
+        return [y], new_state
+
+
+@register("MVN")
+class MVNLayer(Layer):
+    """Mean-variance normalization per sample (mvn_layer.cpp)."""
+
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        from ..proto.config import MVNParameter
+        self.p = self.lp.mvn_param or MVNParameter()
+        return [in_shapes[0]]
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        x = self.f(bottoms[0])
+        nd = x.ndim
+        if self.p.across_channels:
+            axes = tuple(range(1, nd))
+        else:
+            axes = tuple(range(2, nd)) if nd > 2 else (1,)
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        y = x - mean
+        if self.p.normalize_variance:
+            std = jnp.sqrt(jnp.mean(jnp.square(y), axis=axes, keepdims=True))
+            y = y / (std + self.p.eps)
+        return [y], state

@@ -1,0 +1,258 @@
+"""Shape/structure layers: Concat, Slice, Split, Flatten, Reshape, Tile,
+Eltwise, Reduction, ArgMax, Silence, BatchReindex, Filter.
+
+Reference: src/caffe/layers/{concat,slice,split,flatten,reshape,tile,eltwise,
+reduction,argmax,silence,batch_reindex,filter}_layer.{cpp,cu}. All are pure
+data movement/arithmetic; XLA fuses or elides them (Split in particular —
+the reference inserts Split layers to copy a blob consumed twice,
+util/insert_splits.cpp, which a functional graph gets for free)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .base import Layer, Shape, register
+
+
+@register("Concat")
+class ConcatLayer(Layer):
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        p = self.lp.concat_param
+        axis = p.axis if p else 1
+        if p and not p.has("axis") and p.has("concat_dim"):
+            axis = p.concat_dim
+        self.axis = axis % len(in_shapes[0]) if axis < 0 else axis
+        out = list(in_shapes[0])
+        out[self.axis] = sum(s[self.axis] for s in in_shapes)
+        return [tuple(out)]
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        return [jnp.concatenate([self.f(b) for b in bottoms], axis=self.axis)], state
+
+
+@register("Slice")
+class SliceLayer(Layer):
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        p = self.lp.slice_param
+        axis = p.axis if p else 1
+        if p and not p.has("axis") and p.has("slice_dim"):
+            axis = p.slice_dim
+        self.axis = axis % len(in_shapes[0]) if axis < 0 else axis
+        total = in_shapes[0][self.axis]
+        n_top = len(self.lp.top)
+        points = list(p.slice_point) if p else []
+        if points:
+            if len(points) != n_top - 1:
+                raise ValueError(f"{self.name}: need {n_top - 1} slice points")
+            bounds = [0] + points + [total]
+        else:
+            if total % n_top:
+                raise ValueError(f"{self.name}: {total} not divisible by {n_top} tops")
+            step = total // n_top
+            bounds = [i * step for i in range(n_top + 1)]
+        self.bounds = bounds
+        outs = []
+        for i in range(n_top):
+            s = list(in_shapes[0])
+            s[self.axis] = bounds[i + 1] - bounds[i]
+            outs.append(tuple(s))
+        return outs
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        x = self.f(bottoms[0])
+        tops = []
+        for i in range(len(self.bounds) - 1):
+            idx = [slice(None)] * x.ndim
+            idx[self.axis] = slice(self.bounds[i], self.bounds[i + 1])
+            tops.append(x[tuple(idx)])
+        return tops, state
+
+
+@register("Split")
+class SplitLayer(Layer):
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        return [in_shapes[0]] * len(self.lp.top)
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        return [bottoms[0]] * len(self.lp.top), state
+
+
+@register("Flatten")
+class FlattenLayer(Layer):
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        p = self.lp.flatten_param
+        nd = len(in_shapes[0])
+        axis = (p.axis if p else 1) % nd
+        end = (p.end_axis if p else -1) % nd
+        self.axis, self.end = axis, end
+        mid = math.prod(in_shapes[0][axis : end + 1])
+        self.out = (*in_shapes[0][:axis], mid, *in_shapes[0][end + 1 :])
+        return [self.out]
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        return [bottoms[0].reshape(self.out)], state
+
+
+@register("Reshape")
+class ReshapeLayer(Layer):
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        p = self.lp.reshape_param
+        spec = list(p.shape.dim) if (p and p.shape) else []
+        in_shape = in_shapes[0]
+        nd = len(in_shape)
+        start = (p.axis if p else 0) % (nd + 1)
+        num_axes = p.num_axes if p else -1
+        end = nd if num_axes == -1 else start + num_axes
+        head, mid_in, tail = in_shape[:start], in_shape[start:end], in_shape[end:]
+        mid: list[int] = []
+        infer = -1
+        for i, d in enumerate(spec):
+            if d == 0:
+                mid.append(mid_in[i])  # 0 = copy from bottom
+            elif d == -1:
+                infer = i
+                mid.append(-1)
+            else:
+                mid.append(d)
+        if infer >= 0:
+            known = math.prod([d for d in mid if d != -1]) * math.prod(head + tail) if False else math.prod([d for d in mid if d != -1])
+            total_mid = math.prod(mid_in)
+            if known == 0 or total_mid % known:
+                raise ValueError(f"{self.name}: cannot infer -1 dimension")
+            mid[infer] = total_mid // known
+        if math.prod(mid) != math.prod(mid_in):
+            raise ValueError(
+                f"{self.name}: reshape count mismatch {mid_in} -> {mid}"
+            )
+        self.out = (*head, *mid, *tail)
+        return [self.out]
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        return [bottoms[0].reshape(self.out)], state
+
+
+@register("Tile")
+class TileLayer(Layer):
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        p = self.lp.tile_param
+        self.axis = (p.axis if p else 1) % len(in_shapes[0])
+        self.tiles = p.tiles if p else 1
+        out = list(in_shapes[0])
+        out[self.axis] *= self.tiles
+        return [tuple(out)]
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        reps = [1] * bottoms[0].ndim
+        reps[self.axis] = self.tiles
+        return [jnp.tile(bottoms[0], reps)], state
+
+
+@register("Eltwise")
+class EltwiseLayer(Layer):
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        p = self.lp.eltwise_param
+        self.op = str(p.operation).upper() if p else "SUM"
+        self.coeff = list(p.coeff) if p else []
+        if self.coeff and len(self.coeff) != len(self.lp.bottom):
+            raise ValueError(f"{self.name}: coeff count != bottom count")
+        return [in_shapes[0]]
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        xs = [self.f(b) for b in bottoms]
+        if self.op == "PROD":
+            y = xs[0]
+            for x in xs[1:]:
+                y = y * x
+        elif self.op == "MAX":
+            y = xs[0]
+            for x in xs[1:]:
+                y = jnp.maximum(y, x)
+        else:  # SUM
+            if self.coeff:
+                y = sum(c * x for c, x in zip(self.coeff, xs))
+            else:
+                y = sum(xs[1:], xs[0])
+        return [y], state
+
+
+@register("Reduction")
+class ReductionLayer(Layer):
+    """Reduce trailing axes from `axis` on (reduction_layer.cpp)."""
+
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        p = self.lp.reduction_param
+        self.op = str(p.operation).upper() if p else "SUM"
+        axis = (p.axis if p else 0) % len(in_shapes[0])
+        self.axis = axis
+        self.coeff = p.coeff if p else 1.0
+        return [in_shapes[0][:axis]]
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        x = self.f(bottoms[0])
+        axes = tuple(range(self.axis, x.ndim))
+        if self.op == "ASUM":
+            y = jnp.sum(jnp.abs(x), axis=axes)
+        elif self.op == "SUMSQ":
+            y = jnp.sum(jnp.square(x), axis=axes)
+        elif self.op == "MEAN":
+            y = jnp.mean(x, axis=axes)
+        else:
+            y = jnp.sum(x, axis=axes)
+        return [self.coeff * y], state
+
+
+@register("ArgMax")
+class ArgMaxLayer(Layer):
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        p = self.lp.argmax_param
+        self.top_k = p.top_k if p else 1
+        self.out_max_val = bool(p and p.out_max_val)
+        self.axis = p.axis if (p and p.axis is not None) else None
+        n = in_shapes[0][0]
+        if self.axis is not None:
+            out = list(in_shapes[0])
+            out[self.axis % len(out)] = self.top_k
+            return [tuple(out)]
+        if self.out_max_val:
+            return [(n, 2, self.top_k)]
+        return [(n, 1, self.top_k)]
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        x = self.f(bottoms[0]).astype(jnp.float32)
+        if self.axis is not None:
+            ax = self.axis % x.ndim
+            vals, idx = jax.lax.top_k(jnp.moveaxis(x, ax, -1), self.top_k)
+            out = vals if self.out_max_val else idx.astype(jnp.float32)
+            return [jnp.moveaxis(out, -1, ax)], state
+        n = x.shape[0]
+        flat = x.reshape(n, -1)
+        vals, idx = jax.lax.top_k(flat, self.top_k)
+        if self.out_max_val:
+            return [jnp.stack([idx.astype(jnp.float32), vals], axis=1)], state
+        return [idx.astype(jnp.float32)[:, None, :]], state
+
+
+@register("Silence")
+class SilenceLayer(Layer):
+    """Consumes bottoms, produces nothing (silence_layer.cpp)."""
+
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        return []
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        return [], state
+
+
+@register("BatchReindex")
+class BatchReindexLayer(Layer):
+    """Gather along batch dim by an index blob (batch_reindex_layer.cpp)."""
+
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        return [(in_shapes[1][0], *in_shapes[0][1:])]
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        idx = bottoms[1].astype(jnp.int32).reshape(-1)
+        return [jnp.take(self.f(bottoms[0]), idx, axis=0)], state

@@ -1,0 +1,262 @@
+"""Spatial layers: Convolution, Deconvolution, Pooling, LRN, Im2col, Crop, SPP.
+
+Reference implementations: src/caffe/layers/{base_conv,conv,deconv,pooling,
+lrn,im2col,crop,spp}_layer.{cpp,cu} + cudnn variants. The cuDNN engine
+machinery (algo auto-seek, workspace budgets, group streams) has no TPU
+counterpart — XLA owns those decisions — so each layer is only Caffe shape
+semantics + a lax primitive call.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.conv import conv2d, conv_output_dim, deconv2d, im2col
+from ..ops.pool import avg_pool2d, max_pool2d, pool_output_dim
+from ..proto.config import ConvolutionParameter, FillerParameter
+from .base import Layer, Shape, register
+
+
+def _spatial_params(p: ConvolutionParameter) -> tuple[tuple, tuple, tuple, tuple]:
+    """Resolve Caffe's repeated kernel_size/stride/pad + legacy _h/_w fields
+    (base_conv_layer.cpp LayerSetUp)."""
+    def resolve(rep: list[int], h: int, w: int, default: int) -> tuple[int, int]:
+        if h or w:
+            return (h, w)
+        if not rep:
+            return (default, default)
+        if len(rep) == 1:
+            return (rep[0], rep[0])
+        return (rep[0], rep[1])
+
+    kernel = resolve(p.kernel_size, p.kernel_h, p.kernel_w, 0)
+    stride = resolve(p.stride, p.stride_h, p.stride_w, 1)
+    pad = resolve(p.pad, p.pad_h, p.pad_w, 0)
+    dil = tuple(p.dilation) * (2 // max(len(p.dilation), 1)) if p.dilation else (1, 1)
+    if len(dil) == 1:
+        dil = (dil[0], dil[0])
+    if kernel[0] <= 0 or kernel[1] <= 0:
+        raise ValueError("convolution kernel_size must be positive")
+    return kernel, stride, pad, dil
+
+
+@register("Convolution")
+class ConvolutionLayer(Layer):
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        p = self.lp.convolution_param or ConvolutionParameter()
+        self.p = p
+        self.kernel, self.stride, self.pad, self.dilation = _spatial_params(p)
+        n, cin, h, w = in_shapes[0]
+        if cin % p.group or p.num_output % p.group:
+            raise ValueError(f"{self.name}: channels not divisible by group")
+        self.declare("weight",
+                     (p.num_output, cin // p.group, *self.kernel),
+                     p.weight_filler)
+        if p.bias_term:
+            self.declare("bias", (p.num_output,),
+                         p.bias_filler or FillerParameter(type="constant"))
+        oh = conv_output_dim(h, self.kernel[0], self.pad[0], self.stride[0], self.dilation[0])
+        ow = conv_output_dim(w, self.kernel[1], self.pad[1], self.stride[1], self.dilation[1])
+        return [(n, p.num_output, oh, ow)]
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        x = self.f(bottoms[0])
+        w = self.f(params["weight"])
+        y = conv2d(x, w, self.stride, self.pad, self.dilation, self.p.group)
+        if self.p.bias_term:
+            y = y + self.f(params["bias"])[None, :, None, None]
+        return [y], state
+
+
+@register("Deconvolution")
+class DeconvolutionLayer(Layer):
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        p = self.lp.convolution_param or ConvolutionParameter()
+        self.p = p
+        self.kernel, self.stride, self.pad, self.dilation = _spatial_params(p)
+        n, cin, h, w = in_shapes[0]
+        # Caffe deconv weight shape: (Cin, Cout/group, kh, kw) — conv layout
+        # with the feature roles swapped (deconv_layer.cpp).
+        self.declare("weight",
+                     (cin, p.num_output // p.group, *self.kernel),
+                     p.weight_filler)
+        if p.bias_term:
+            self.declare("bias", (p.num_output,),
+                         p.bias_filler or FillerParameter(type="constant"))
+        kh_ext = self.dilation[0] * (self.kernel[0] - 1) + 1
+        kw_ext = self.dilation[1] * (self.kernel[1] - 1) + 1
+        oh = self.stride[0] * (h - 1) + kh_ext - 2 * self.pad[0]
+        ow = self.stride[1] * (w - 1) + kw_ext - 2 * self.pad[1]
+        return [(n, p.num_output, oh, ow)]
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        x = self.f(bottoms[0])
+        w = self.f(params["weight"])
+        y = deconv2d(x, w, self.stride, self.pad, self.dilation, self.p.group)
+        if self.p.bias_term:
+            y = y + self.f(params["bias"])[None, :, None, None]
+        return [y], state
+
+
+@register("Pooling")
+class PoolingLayer(Layer):
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        p = self.lp.pooling_param
+        self.p = p
+        n, c, h, w = in_shapes[0]
+        if p.global_pooling:
+            self.kernel = (h, w)
+            self.stride = (1, 1)
+            self.pad = (0, 0)
+        else:
+            kh = p.kernel_h or p.kernel_size
+            kw = p.kernel_w or p.kernel_size
+            if kh <= 0 or kw <= 0:
+                raise ValueError(f"{self.name}: pooling kernel_size required")
+            self.kernel = (kh, kw)
+            self.stride = (p.stride_h or p.stride, p.stride_w or p.stride)
+            self.pad = (p.pad_h or p.pad, p.pad_w or p.pad)
+        oh = pool_output_dim(h, self.kernel[0], self.pad[0], self.stride[0])
+        ow = pool_output_dim(w, self.kernel[1], self.pad[1], self.stride[1])
+        self.method = str(p.pool).upper()
+        if self.method == "STOCHASTIC":
+            raise NotImplementedError(
+                "STOCHASTIC pooling is not implemented yet (reference "
+                "pooling_layer.cpp:239); use MAX or AVE"
+            )
+        return [(n, c, oh, ow)]
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        x = self.f(bottoms[0])
+        if self.method == "AVE":
+            y = avg_pool2d(x, self.kernel, self.stride, self.pad)
+        else:
+            y = max_pool2d(x, self.kernel, self.stride, self.pad)
+        return [y], state
+
+
+@register("LRN")
+class LRNLayer(Layer):
+    """Local response normalization (lrn_layer.cpp):
+    y = x * (k + (alpha/n) * sum_window(x^2))^(-beta)."""
+
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        p = self.lp.lrn_param
+        if p is None:
+            from ..proto.config import LRNParameter
+            p = LRNParameter()
+        if p.local_size % 2 != 1:
+            raise ValueError("LRN local_size must be odd")
+        self.p = p
+        self.region = str(p.norm_region).upper()
+        return [in_shapes[0]]
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        x = self.f(bottoms[0])
+        p = self.p
+        sq = jnp.square(x)
+        half = (p.local_size - 1) // 2
+        if self.region == "WITHIN_CHANNEL":
+            # spatial window, divisor is the full window size (lrn pads with 0)
+            window_sum = lax.reduce_window(
+                sq, jnp.zeros((), x.dtype), lax.add,
+                window_dimensions=(1, 1, p.local_size, p.local_size),
+                window_strides=(1, 1, 1, 1),
+                padding=((0, 0), (0, 0), (half, half), (half, half)),
+            )
+            scale = p.k + window_sum * (p.alpha / (p.local_size * p.local_size))
+        else:
+            # across channels: 1-D window over C
+            window_sum = lax.reduce_window(
+                sq, jnp.zeros((), x.dtype), lax.add,
+                window_dimensions=(1, p.local_size, 1, 1),
+                window_strides=(1, 1, 1, 1),
+                padding=((0, 0), (half, half), (0, 0), (0, 0)),
+            )
+            scale = p.k + window_sum * (p.alpha / p.local_size)
+        return [x * jnp.power(scale, -p.beta)], state
+
+
+@register("Im2col")
+class Im2colLayer(Layer):
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        from ..proto.config import ConvolutionParameter as CP
+        p = self.lp.convolution_param or CP()
+        self.kernel, self.stride, self.pad, self.dilation = _spatial_params(p)
+        n, c, h, w = in_shapes[0]
+        oh = conv_output_dim(h, self.kernel[0], self.pad[0], self.stride[0], self.dilation[0])
+        ow = conv_output_dim(w, self.kernel[1], self.pad[1], self.stride[1], self.dilation[1])
+        return [(n, c * self.kernel[0] * self.kernel[1], oh, ow)]
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        y = im2col(self.f(bottoms[0]), self.kernel, self.stride, self.pad,
+                   self.dilation)
+        return [y], state
+
+
+@register("Crop")
+class CropLayer(Layer):
+    """Crop bottom[0] to bottom[1]'s shape from `axis` on, at `offset`
+    (crop_layer.cpp)."""
+
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        p = self.lp.crop_param
+        axis = p.axis if p else 2
+        offsets = list(p.offset) if p else []
+        a, b = in_shapes[0], in_shapes[1]
+        out = list(a)
+        self.starts = [0] * len(a)
+        for i in range(axis, len(a)):
+            off = 0
+            if offsets:
+                off = offsets[i - axis] if len(offsets) > 1 else offsets[0]
+            if off + b[i] > a[i]:
+                raise ValueError(f"{self.name}: crop exceeds bottom size on axis {i}")
+            self.starts[i] = off
+            out[i] = b[i]
+        self.out = tuple(out)
+        return [self.out]
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        x = bottoms[0]
+        y = lax.dynamic_slice(x, tuple(self.starts), self.out)
+        return [y], state
+
+
+@register("SPP")
+class SPPLayer(Layer):
+    """Spatial pyramid pooling (spp_layer.cpp): pyramid of global-ish max/ave
+    pools flattened+concatenated."""
+
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        p = self.lp.spp_param
+        self.height = p.pyramid_height
+        self.method = str(p.pool).upper() if p else "MAX"
+        n, c, h, w = in_shapes[0]
+        self.levels = []
+        total = 0
+        import math
+        for l in range(self.height):
+            bins = 2 ** l
+            kh, kw = math.ceil(h / bins), math.ceil(w / bins)
+            ph = (kh * bins - h + 1) // 2
+            pw = (kw * bins - w + 1) // 2
+            self.levels.append(((kh, kw), (kh, kw), (ph, pw), bins))
+            total += c * bins * bins
+        return [(n, total)]
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        x = self.f(bottoms[0])
+        n = x.shape[0]
+        outs = []
+        for (kernel, stride, pad, bins) in self.levels:
+            if self.method == "AVE":
+                y = avg_pool2d(x, kernel, stride, pad)
+            else:
+                y = max_pool2d(x, kernel, stride, pad)
+            outs.append(y[:, :, :bins, :bins].reshape(n, -1))
+        return [jnp.concatenate(outs, axis=1)], state

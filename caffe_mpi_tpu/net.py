@@ -1,0 +1,237 @@
+"""Net — graph runtime. The functional replacement for reference net.cpp.
+
+The reference's Net (src/caffe/net.cpp, 1,376 LoC) builds a layer DAG from
+NetParameter, allocates blobs, runs sequential Forward/Backward loops with a
+dedicated gradient-reduction thread, and manages a contiguous learnable-diff
+space for bucketed NCCL allreduce (net.cpp:757-913, 1350-1374).
+
+TPU-native design: the graph compiles into ONE pure function
+  apply(params, state, feeds) -> (blobs, new_state, loss)
+and the backward pass is jax.grad of that function inside a single jit-ted
+train step. That one decision subsumes several reference subsystems:
+- insert_splits.cpp         -> unnecessary (values are immutable, fan-out is free)
+- reduce thread + buckets   -> XLA latency-hiding scheduler overlaps psum
+                               with backward automatically
+- learnable diff space      -> XLA's buffer assignment
+- backward-need analysis    -> stop_gradient on lr_mult=0 params + XLA DCE
+What remains faithful: layer declaration order IS execution order, in-place
+tops, loss_weight semantics, param sharing by ParamSpec.name, phase filtering,
+per-layer dtype policy.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .core.types import DtypePolicy
+from .layers import base as layer_base
+from .layers.base import Layer, create_layer
+from .layers.data_layers import InputLayerBase
+from .proto.config import NetParameter, NetState
+from .proto.upgrade import filter_net, normalize_net
+
+log = logging.getLogger(__name__)
+
+Params = dict[str, dict[str, jax.Array]]
+State = dict[str, dict[str, jax.Array]]
+
+
+class Net:
+    """Build from a (filtered) NetParameter; compile via jit around apply()."""
+
+    def __init__(self, param: NetParameter, phase: str = "TRAIN", *,
+                 level: int = 0, stages: Sequence[str] = (),
+                 batch_divisor: int = 1,
+                 data_shape_probe=None):
+        """batch_divisor: divide data-layer batch sizes by the per-replica
+        count, reproducing divide_batch_size (reference parallel.cpp:295-348).
+        data_shape_probe: callable(layer_param) -> (C,H,W) for DB-backed
+        layers whose shape comes from the dataset."""
+        param = normalize_net(param)
+        state = NetState(phase=phase, level=level, stage=list(stages))
+        param = filter_net(param, state)
+        self.param = param
+        self.phase = phase
+        self.name = param.name
+
+        self.layers: list[Layer] = []
+        self.blob_shapes: dict[str, tuple] = {}
+        self.feed_blobs: list[str] = []  # blob names fed from host
+        self.loss_blobs: list[tuple[str, float]] = []  # (blob, weight)
+        # param sharing: ParamSpec.name -> (owner layer, param name)
+        self._shared_owner: dict[str, tuple[str, str]] = {}
+        self.param_aliases: dict[tuple[str, str], tuple[str, str]] = {}
+
+        solver_storage = "FLOAT"
+        for lp in param.layer:
+            policy = DtypePolicy.resolve(
+                lp.forward_type, lp.backward_type,
+                param.default_forward_type, param.default_backward_type,
+                solver_storage,
+            )
+            if lp.type in ("Data", "ImageData") and batch_divisor > 1:
+                self._divide_batch(lp, batch_divisor)
+            layer = create_layer(lp, policy, phase)
+            if lp.type == "Data" and data_shape_probe is not None:
+                layer.bound_shape = data_shape_probe(lp)
+            # resolve bottoms
+            in_shapes = []
+            for b in lp.bottom:
+                if b not in self.blob_shapes:
+                    raise ValueError(
+                        f"layer {lp.name!r}: unknown bottom blob {b!r} "
+                        "(layers execute in declaration order)"
+                    )
+                in_shapes.append(self.blob_shapes[b])
+            layer.in_shapes = in_shapes
+            out_shapes = layer.setup(in_shapes)
+            layer.out_shapes = out_shapes
+            if len(out_shapes) != len(lp.top) and lp.type != "Silence":
+                raise ValueError(
+                    f"layer {lp.name!r}: produces {len(out_shapes)} tops, "
+                    f"prototxt names {len(lp.top)}"
+                )
+            for t, s in zip(lp.top, out_shapes):
+                if t in self.blob_shapes and t not in lp.bottom:
+                    raise ValueError(f"duplicate top blob {t!r} (layer {lp.name!r})")
+                self.blob_shapes[t] = tuple(s)
+            if isinstance(layer, InputLayerBase):
+                self.feed_blobs.extend(lp.top)
+            # loss weights (reference layer.hpp SetLossWeights)
+            for ti, t in enumerate(lp.top):
+                w = (lp.loss_weight[ti] if ti < len(lp.loss_weight)
+                     else layer.default_loss_weight(ti))
+                if w:
+                    self.loss_blobs.append((t, w))
+            # param sharing bookkeeping
+            for pname, decl in layer.params.items():
+                key = (lp.name, pname)
+                if decl.shared_name:
+                    owner = self._shared_owner.get(decl.shared_name)
+                    if owner is None:
+                        self._shared_owner[decl.shared_name] = key
+                    else:
+                        owner_layer = self._layer_by_name(owner[0])
+                        if owner_layer.params[owner[1]].shape != decl.shape:
+                            raise ValueError(
+                                f"shared param {decl.shared_name!r}: shape "
+                                f"mismatch {decl.shape} vs "
+                                f"{owner_layer.params[owner[1]].shape}"
+                            )
+                        self.param_aliases[key] = owner
+            self.layers.append(layer)
+
+        dups = len(self.feed_blobs) - len(set(self.feed_blobs))
+        if dups:
+            raise ValueError("duplicate feed blob names")
+
+    # ------------------------------------------------------------------
+    def _divide_batch(self, lp, divisor: int) -> None:
+        p = lp.data_param if lp.type == "Data" else lp.image_data_param
+        if p and p.batch_size:
+            if p.batch_size % divisor:
+                log.warning(
+                    "layer %s: batch_size %d not divisible by %d replicas; "
+                    "rounding up (reference parallel.cpp:284-293)",
+                    lp.name, p.batch_size, divisor)
+            p.batch_size = max(1, (p.batch_size + divisor - 1) // divisor)
+
+    def _layer_by_name(self, name: str) -> Layer:
+        for l in self.layers:
+            if l.name == name:
+                return l
+        raise KeyError(name)
+
+    # ------------------------------------------------------------------
+    def init(self, key: jax.Array) -> tuple[Params, State]:
+        """Initialize params/state. Shared params are stored once (under the
+        owning layer) — aliases resolve at apply time, mirroring the
+        reference's learnable-param ownership (net.cpp AppendParam)."""
+        params: Params = {}
+        state: State = {}
+        for i, layer in enumerate(self.layers):
+            lkey = jax.random.fold_in(key, i)
+            p = {}
+            inited = layer.init_params(lkey)
+            for pname, arr in inited.items():
+                if (layer.name, pname) in self.param_aliases:
+                    continue  # owner holds it
+                p[pname] = arr
+            if p:
+                params[layer.name] = p
+            s = layer.init_state()
+            if s:
+                state[layer.name] = s
+        return params, state
+
+    def _layer_params(self, layer: Layer, params: Params, train: bool) -> dict:
+        out = {}
+        for pname, decl in layer.params.items():
+            owner = self.param_aliases.get((layer.name, pname), (layer.name, pname))
+            arr = params[owner[0]][owner[1]]
+            if train and decl.lr_mult == 0.0:
+                # frozen: reference's backward-need analysis skips grad
+                # computation (net.cpp:285-360); stop_gradient lets XLA DCE it
+                arr = jax.lax.stop_gradient(arr)
+            out[pname] = arr
+        return out
+
+    # ------------------------------------------------------------------
+    def apply(self, params: Params, state: State, feeds: dict[str, jax.Array],
+              *, train: bool, rng: jax.Array | None = None
+              ) -> tuple[dict[str, jax.Array], State, jax.Array]:
+        """Run the graph. Returns (all named blobs, new state, total loss)."""
+        env: dict[str, jax.Array] = {}
+        new_state: State = dict(state)
+        for i, layer in enumerate(self.layers):
+            lrng = jax.random.fold_in(rng, i) if rng is not None else None
+            lparams = self._layer_params(layer, params, train)
+            lstate = state.get(layer.name, {})
+            if isinstance(layer, InputLayerBase):
+                try:
+                    bottoms = [feeds[t] for t in layer.lp.top]
+                except KeyError as e:
+                    raise KeyError(
+                        f"input layer {layer.name!r}: missing feed for blob {e}"
+                    ) from None
+                for t, shape in zip(layer.lp.top, layer.out_shapes):
+                    if tuple(feeds[t].shape) != tuple(shape):
+                        raise ValueError(
+                            f"feed {t!r}: shape {feeds[t].shape} != declared {shape}"
+                        )
+            else:
+                bottoms = [env[b] for b in layer.lp.bottom]
+            tops, lstate_new = layer.apply(lparams, lstate, bottoms,
+                                           train=train, rng=lrng)
+            if lstate_new is not lstate and lstate_new:
+                new_state[layer.name] = lstate_new
+            for t, v in zip(layer.lp.top, tops):
+                env[t] = v
+        loss = jnp.zeros((), jnp.float32)
+        for blob, w in self.loss_blobs:
+            contrib = env[blob].astype(jnp.float32)
+            loss = loss + w * jnp.sum(contrib)
+        return env, new_state, loss
+
+    # ------------------------------------------------------------------
+    def forward(self, params: Params, state: State, feeds: dict[str, jax.Array],
+                *, rng=None):
+        """Inference-style forward (reference Net::Forward)."""
+        return self.apply(params, state, feeds, train=False, rng=rng)
+
+    # -- introspection (pycaffe parity helpers) -------------------------
+    def learnable_param_decls(self):
+        """Yield (layer_name, param_name, decl) for each OWNED param, in
+        declaration order — the analogue of Net::learnable_params()."""
+        for layer in self.layers:
+            for pname, decl in layer.params.items():
+                if (layer.name, pname) in self.param_aliases:
+                    continue
+                yield layer.name, pname, decl
+
+    def num_learnable_params(self) -> int:
+        return sum(1 for _ in self.learnable_param_decls())

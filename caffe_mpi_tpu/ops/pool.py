@@ -1,0 +1,81 @@
+"""Pooling with exact Caffe output-size and divisor semantics.
+
+Reference: src/caffe/layers/pooling_layer.cpp.
+- Output size rounds UP: ceil((H + 2p - k)/s) + 1 (pooling_layer.cpp:92-95),
+  then clipped so the last window starts inside the padded image
+  (pooling_layer.cpp:99-107). Most frameworks floor; the parity of AlexNet /
+  GoogLeNet feature-map sizes depends on this.
+- AVE pooling divides by the window's intersection with the *padded* image
+  (count includes pad cells, clipped at H+p on the high side) — the
+  hstart/hend/pool_size arithmetic at pooling_layer.cpp:196-215.
+
+Implemented on `lax.reduce_window`, which XLA lowers to fused TPU
+vector-unit loops; the backward pass (the reference's hand-written
+MaxPoolBackward/AvePoolBackward CUDA kernels) comes from jax.grad through
+reduce_window's built-in VJP (select-and-scatter on TPU).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def pool_output_dim(size: int, kernel: int, pad: int, stride: int) -> int:
+    out = int(math.ceil((size + 2 * pad - kernel) / stride)) + 1
+    if pad > 0 and (out - 1) * stride >= size + pad:
+        out -= 1
+    return out
+
+
+def _pad_amounts(size: int, kernel: int, pad: int, stride: int, out: int) -> tuple[int, int]:
+    """(lo, hi) padding so reduce_window emits exactly `out` positions."""
+    hi = (out - 1) * stride + kernel - size - pad
+    return pad, max(hi, 0)
+
+
+def max_pool2d(x: jnp.ndarray, kernel: tuple[int, int], stride: tuple[int, int],
+               pad: tuple[int, int]) -> jnp.ndarray:
+    """NCHW max pooling, Caffe ceil-mode output size."""
+    n, c, h, w = x.shape
+    oh = pool_output_dim(h, kernel[0], pad[0], stride[0])
+    ow = pool_output_dim(w, kernel[1], pad[1], stride[1])
+    ph = _pad_amounts(h, kernel[0], pad[0], stride[0], oh)
+    pw = _pad_amounts(w, kernel[1], pad[1], stride[1], ow)
+    neg_inf = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    return lax.reduce_window(
+        x, neg_inf, lax.max,
+        window_dimensions=(1, 1, *kernel),
+        window_strides=(1, 1, *stride),
+        padding=((0, 0), (0, 0), ph, pw),
+    )
+
+
+def avg_pool2d(x: jnp.ndarray, kernel: tuple[int, int], stride: tuple[int, int],
+               pad: tuple[int, int]) -> jnp.ndarray:
+    """NCHW average pooling with Caffe's padded-window divisor."""
+    n, c, h, w = x.shape
+    oh = pool_output_dim(h, kernel[0], pad[0], stride[0])
+    ow = pool_output_dim(w, kernel[1], pad[1], stride[1])
+    ph = _pad_amounts(h, kernel[0], pad[0], stride[0], oh)
+    pw = _pad_amounts(w, kernel[1], pad[1], stride[1], ow)
+    sums = lax.reduce_window(
+        x, jnp.zeros((), x.dtype), lax.add,
+        window_dimensions=(1, 1, *kernel),
+        window_strides=(1, 1, *stride),
+        padding=((0, 0), (0, 0), ph, pw),
+    )
+    # divisor: |[hstart, min(hstart+k, H+pad))| per position, hstart = i*s - pad
+    # (pooling_layer.cpp:198-201); static — computed with numpy at trace time.
+    def divisors(size, kernel_, pad_, stride_, out):
+        starts = np.arange(out) * stride_ - pad_
+        ends = np.minimum(starts + kernel_, size + pad_)
+        return (ends - starts).astype(np.float32)
+
+    dh = divisors(h, kernel[0], pad[0], stride[0], oh)
+    dw = divisors(w, kernel[1], pad[1], stride[1], ow)
+    div = jnp.asarray(np.outer(dh, dw), x.dtype)
+    return sums / div[None, None, :, :]

@@ -34,4 +34,15 @@ from .config import (
 )
 from .upgrade import filter_net, layer_included, normalize_net, state_meets_rule
 
-__all__ = [s for s in dir() if not s.startswith("_")]
+__all__ = [
+    "AccuracyParameter", "BatchNormParameter", "BiasParameter", "BlobShape",
+    "ConcatParameter", "ConvolutionParameter", "DataParameter",
+    "DropoutParameter", "DummyDataParameter", "EltwiseParameter",
+    "FillerParameter", "InnerProductParameter", "InputParameter",
+    "LayerParameter", "LossParameter", "LRNParameter", "Message",
+    "NetParameter", "NetState", "NetStateRule", "ParamSpec", "PbEnum",
+    "PbNode", "PoolingParameter", "PrototxtError", "ReLUParameter",
+    "ScaleParameter", "SliceParameter", "SoftmaxParameter", "SolverParameter",
+    "TransformationParameter", "filter_net", "layer_included", "normalize_net",
+    "parse", "parse_file", "solver_type", "state_meets_rule",
+]

@@ -16,6 +16,7 @@ rather than crashing, mirroring proto2's tolerant-reader behavior.
 from __future__ import annotations
 
 import dataclasses
+import types
 import typing
 from dataclasses import dataclass, field as dc_field
 from typing import Any, get_args, get_origin
@@ -82,7 +83,7 @@ class Message:
             if not vals:
                 continue
             origin = get_origin(target)
-            if origin is typing.Union or str(origin) == "<class 'types.UnionType'>":
+            if origin is typing.Union or origin is types.UnionType:
                 non_none = [a for a in get_args(target) if a is not type(None)]
                 target = non_none[0]
                 origin = get_origin(target)
@@ -738,6 +739,7 @@ class SolverParameter(Message):
     # momentum policy (caffe.proto:228-230; sgd_solver.cpp:67-91)
     momentum_policy: str = "fixed"
     max_momentum: float = 0.0
+    momentum_power: float = 1.0
     momentum2: float = 0.999
     rms_decay: float = 0.99
     delta: float = 1e-8
@@ -781,7 +783,15 @@ SOLVER_TYPE_NAMES = {
 
 def solver_type(solver: SolverParameter) -> str:
     """Resolve modern `type` vs legacy `solver_type` enum
-    (reference: solver_factory upgrade path)."""
-    if solver.has("type") or solver.solver_type == "":
+    (reference: upgrade_proto.cpp UpgradeSolverType, which forbids setting
+    both and rejects unknown enum values)."""
+    if solver.has("type") and solver.has("solver_type"):
+        raise ValueError(
+            "solver sets both 'type' and legacy 'solver_type'; remove one"
+        )
+    if not solver.has("solver_type"):
         return solver.type
-    return SOLVER_TYPE_NAMES.get(str(solver.solver_type).upper(), solver.type)
+    key = str(solver.solver_type).upper()
+    if key not in SOLVER_TYPE_NAMES:
+        raise ValueError(f"unknown legacy solver_type {solver.solver_type!r}")
+    return SOLVER_TYPE_NAMES[key]

@@ -291,7 +291,13 @@ class _Parser:
                 if t.text == ",":
                     self.next()
                     continue
-                node.add(name, self.parse_scalar())
+                if t.text == "{" or t.text == "<":
+                    # repeated-message short form: field: [{...}, {...}]
+                    self.next()
+                    node.add(name, self.parse_message(
+                        "}" if t.text == "{" else ">"))
+                else:
+                    node.add(name, self.parse_scalar())
             return
         node.add(name, self.parse_scalar())
 

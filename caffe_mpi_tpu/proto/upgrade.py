@@ -20,6 +20,7 @@ from .config import (
     NetParameter,
     NetState,
     NetStateRule,
+    ParamSpec,
 )
 
 # V1LayerParameter ALL-CAPS enum -> modern type string
@@ -56,6 +57,7 @@ def normalize_net(net: NetParameter) -> NetParameter:
     for lp in net.layer:
         if lp.type in _V1_TYPE_NAMES:
             lp.type = _V1_TYPE_NAMES[lp.type]
+        _migrate_v1_blob_multipliers(lp)
     # Legacy net-level inputs -> synthetic Input layer at the front
     # (reference upgrade_proto.cpp UpgradeNetInput).
     if net.input:
@@ -78,6 +80,33 @@ def normalize_net(net: NetParameter) -> NetParameter:
         net.layer.insert(0, lp)
         net.input, net.input_shape, net.input_dim = [], [], []
     return net
+
+
+def _migrate_v1_blob_multipliers(lp: LayerParameter) -> None:
+    """V1LayerParameter's per-blob `blobs_lr`/`weight_decay` repeated fields
+    become param { lr_mult/decay_mult } specs (reference upgrade_proto.cpp
+    UpgradeV1LayerParameter). Without this, a legacy net freezing a layer
+    with blobs_lr: 0 would silently train it."""
+    node = getattr(lp, "_node", None)
+    if node is None:
+        return
+    lrs = node.get_list("blobs_lr")
+    wds = node.get_list("weight_decay")
+    if not lrs and not wds:
+        return
+    if lp.param:
+        raise ValueError(
+            f"layer {lp.name!r} mixes legacy blobs_lr/weight_decay with "
+            "modern param specs"
+        )
+    n = max(len(lrs), len(wds))
+    for i in range(n):
+        spec = ParamSpec()
+        if i < len(lrs):
+            spec.lr_mult = float(lrs[i])
+        if i < len(wds):
+            spec.decay_mult = float(wds[i])
+        lp.param.append(spec)
 
 
 def state_meets_rule(state: NetState, rule: NetStateRule) -> bool:

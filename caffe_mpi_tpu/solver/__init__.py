@@ -1,0 +1,3 @@
+from .solver import Solver
+from .updates import UPDATE_FNS, Hyper, n_slots
+from . import lr_policy

@@ -5,19 +5,31 @@ P2PManager over k GPUs (test_gradient_based_solver.cpp:201-217) and leaves
 multi-node untested. Here the same gap is closed portably: XLA's host
 platform is split into 8 virtual devices so mesh/psum/pjit paths run as a
 real 8-way SPMD program on CPU.
+
+Platform forcing: this environment's sitecustomize registers a TPU ("axon")
+PJRT plugin at interpreter startup and pins jax_platforms to it. Backends
+initialize lazily, so overriding jax.config *before any jax computation*
+(conftest import time) still wins. XLA_FLAGS must likewise be set before the
+CPU client is created.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import pytest
+
+assert jax.devices()[0].platform == "cpu", "tests must run on the CPU platform"
+assert len(jax.devices()) == 8, "expected 8 virtual CPU devices"
 
 
 @pytest.fixture

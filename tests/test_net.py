@@ -1,0 +1,163 @@
+"""Net graph-runtime tests (mirrors reference test_net.cpp scope):
+construction from prototxt, forward, loss weighting, in-place ops,
+param sharing, frozen params, jax.grad through the whole graph."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from caffe_mpi_tpu.net import Net
+from caffe_mpi_tpu.proto import NetParameter
+
+MLP = """
+name: "mlp"
+layer {
+  name: "data" type: "Input" top: "data" top: "label"
+  input_param { shape { dim: 8 dim: 10 } shape { dim: 8 } }
+}
+layer {
+  name: "ip1" type: "InnerProduct" bottom: "data" top: "ip1"
+  inner_product_param { num_output: 16 weight_filler { type: "xavier" } }
+}
+layer { name: "relu1" type: "ReLU" bottom: "ip1" top: "ip1" }
+layer {
+  name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+  inner_product_param { num_output: 4 weight_filler { type: "xavier" } }
+}
+layer {
+  name: "loss" type: "SoftmaxWithLoss" bottom: "ip2" bottom: "label" top: "loss"
+  include { phase: TRAIN }
+}
+layer {
+  name: "acc" type: "Accuracy" bottom: "ip2" bottom: "label" top: "acc"
+  include { phase: TEST }
+}
+"""
+
+
+def feeds(rng):
+    return {
+        "data": jnp.asarray(rng.randn(8, 10).astype(np.float32)),
+        "label": jnp.asarray(rng.randint(0, 4, 8)),
+    }
+
+
+class TestNetBuild:
+    def test_shapes_and_phase(self):
+        net = Net(NetParameter.from_text(MLP), phase="TRAIN")
+        assert [l.name for l in net.layers] == ["data", "ip1", "relu1", "ip2", "loss"]
+        assert net.blob_shapes["ip1"] == (8, 16)
+        assert net.blob_shapes["ip2"] == (8, 4)
+        assert net.blob_shapes["loss"] == ()
+        assert net.loss_blobs == [("loss", 1.0)]
+        test_net = Net(NetParameter.from_text(MLP), phase="TEST")
+        assert [l.name for l in test_net.layers][-1] == "acc"
+        assert test_net.loss_blobs == []
+
+    def test_unknown_bottom_raises(self):
+        bad = 'layer { name: "r" type: "ReLU" bottom: "nope" top: "y" }'
+        with pytest.raises(ValueError, match="unknown bottom"):
+            Net(NetParameter.from_text(bad))
+
+    def test_forward_and_loss(self, rng):
+        net = Net(NetParameter.from_text(MLP), phase="TRAIN")
+        params, state = net.init(jax.random.PRNGKey(0))
+        blobs, _, loss = net.apply(params, state, feeds(rng), train=True,
+                                   rng=jax.random.PRNGKey(1))
+        assert blobs["loss"].shape == ()
+        assert float(loss) == pytest.approx(float(blobs["loss"]))
+        assert float(loss) > 0
+
+    def test_grad_through_net(self, rng):
+        net = Net(NetParameter.from_text(MLP), phase="TRAIN")
+        params, state = net.init(jax.random.PRNGKey(0))
+        fd = feeds(rng)
+
+        def loss_fn(p):
+            _, _, loss = net.apply(p, state, fd, train=True,
+                                   rng=jax.random.PRNGKey(1))
+            return loss
+
+        grads = jax.grad(loss_fn)(params)
+        assert set(grads) == {"ip1", "ip2"}
+        assert float(jnp.sum(jnp.abs(grads["ip1"]["weight"]))) > 0
+
+    def test_frozen_param_gets_zero_grad(self, rng):
+        frozen = MLP.replace(
+            'name: "ip1" type: "InnerProduct" bottom: "data" top: "ip1"',
+            'name: "ip1" type: "InnerProduct" bottom: "data" top: "ip1"\n'
+            '  param { lr_mult: 0 } param { lr_mult: 0 }',
+        )
+        net = Net(NetParameter.from_text(frozen), phase="TRAIN")
+        params, state = net.init(jax.random.PRNGKey(0))
+        fd = feeds(rng)
+        grads = jax.grad(
+            lambda p: net.apply(p, state, fd, train=True,
+                                rng=jax.random.PRNGKey(1))[2]
+        )(params)
+        assert float(jnp.sum(jnp.abs(grads["ip1"]["weight"]))) == 0.0
+        assert float(jnp.sum(jnp.abs(grads["ip2"]["weight"]))) > 0
+
+    def test_param_sharing(self, rng):
+        shared = """
+        layer { name: "in" type: "Input" top: "x"
+                input_param { shape { dim: 2 dim: 5 } } }
+        layer { name: "a" type: "InnerProduct" bottom: "x" top: "a"
+                param { name: "w" } param { name: "bb" }
+                inner_product_param { num_output: 5 weight_filler { type: "xavier" } } }
+        layer { name: "b" type: "InnerProduct" bottom: "a" top: "b"
+                param { name: "w" } param { name: "bb" }
+                inner_product_param { num_output: 5 weight_filler { type: "xavier" } } }
+        """
+        net = Net(NetParameter.from_text(shared))
+        params, state = net.init(jax.random.PRNGKey(0))
+        assert "b" not in params  # layer b aliases layer a's params
+        assert net.param_aliases[("b", "weight")] == ("a", "weight")
+        x = jnp.asarray(rng.randn(2, 5).astype(np.float32))
+        blobs, _, _ = net.apply(params, state, {"x": x}, train=False)
+        w, bias = np.array(params["a"]["weight"]), np.array(params["a"]["bias"])
+        expect = (np.array(x) @ w.T + bias) @ w.T + bias
+        np.testing.assert_allclose(np.array(blobs["b"]), expect, rtol=1e-4)
+
+    def test_in_place_and_loss_weight(self, rng):
+        two_loss = MLP.replace(
+            'name: "loss" type: "SoftmaxWithLoss" bottom: "ip2" bottom: "label" top: "loss"',
+            'name: "loss" type: "SoftmaxWithLoss" bottom: "ip2" bottom: "label" top: "loss"\n'
+            '  loss_weight: 2.5',
+        )
+        net = Net(NetParameter.from_text(two_loss), phase="TRAIN")
+        params, state = net.init(jax.random.PRNGKey(0))
+        blobs, _, loss = net.apply(params, state, feeds(rng), train=True,
+                                   rng=jax.random.PRNGKey(1))
+        assert float(loss) == pytest.approx(2.5 * float(blobs["loss"]), rel=1e-5)
+
+    def test_jit_apply(self, rng):
+        net = Net(NetParameter.from_text(MLP), phase="TRAIN")
+        params, state = net.init(jax.random.PRNGKey(0))
+        fd = feeds(rng)
+
+        @jax.jit
+        def step(p, s, f):
+            return net.apply(p, s, f, train=True, rng=jax.random.PRNGKey(1))[2]
+
+        l1 = step(params, state, fd)
+        l2 = step(params, state, fd)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+
+
+class TestReferenceZooDeploy:
+    """Build real reference deploy nets end-to-end (shape inference across
+    the whole zoo is the strongest graph-construction test)."""
+
+    @pytest.mark.parametrize("path,out_blob,classes", [
+        ("/root/reference/models/bvlc_alexnet/deploy.prototxt", "prob", 1000),
+        ("/root/reference/models/bvlc_googlenet/deploy.prototxt", "prob", 1000),
+        ("/root/reference/models/resnet18/deploy.prototxt", "prob", 1000),
+    ])
+    def test_deploy_builds(self, path, out_blob, classes):
+        import os
+        if not os.path.exists(path):
+            pytest.skip("reference not mounted")
+        net = Net(NetParameter.from_file(path), phase="TEST")
+        assert net.blob_shapes[out_blob][1] == classes

@@ -224,6 +224,41 @@ class TestFiltering:
                 )
             )
 
+    def test_v1_blob_multipliers_migrate(self):
+        net = normalize_net(
+            NetParameter.from_text(
+                """
+                layers { name: "c" type: CONVOLUTION bottom: "d" top: "c"
+                         blobs_lr: 1 blobs_lr: 2 weight_decay: 1 weight_decay: 0 }
+                """
+            )
+        )
+        specs = net.layer[0].param
+        assert [(s.lr_mult, s.decay_mult) for s in specs] == [(1.0, 1.0), (2.0, 0.0)]
+
+    def test_v1_blob_multipliers_from_reference_file(self):
+        import os
+        path = "/root/reference/examples/mnist/lenet_consolidated_solver.prototxt"
+        if not os.path.exists(path):
+            pytest.skip("reference not mounted")
+        sp = SolverParameter.from_file(path)
+        net = normalize_net(sp.net_param)
+        conv1 = [l for l in net.layer if l.name == "conv1"][0]
+        assert [s.lr_mult for s in conv1.param] == [1.0, 2.0]
+
+    def test_repeated_message_list_form(self):
+        net = NetParameter.from_text(
+            'layer { name: "c" type: "Convolution" '
+            'param: [{ lr_mult: 1 }, { lr_mult: 2 }] }'
+        )
+        assert [p.lr_mult for p in net.layer[0].param] == [1.0, 2.0]
+
+    def test_solver_type_conflicts(self):
+        with pytest.raises(ValueError, match="both"):
+            solver_type(SolverParameter.from_text('type: "Adam" solver_type: SGD'))
+        with pytest.raises(ValueError, match="unknown legacy"):
+            solver_type(SolverParameter.from_text("solver_type: 9"))
+
     def test_legacy_upgrade(self):
         net = normalize_net(
             NetParameter.from_text(

@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Benchmark — prints ONE JSON line with the headline metric.
+
+Metric: AlexNet training throughput (img/s) at batch 256 on one chip,
+f32 — directly comparable to the reference's published single-GPU number:
+CaffeNet 20 iterations x 256 images in 19.2 s with cuDNN on a Tesla K40
+(docs/performance_hardware.md:17-24) = 266.7 img/s. That is the only
+absolute throughput number published in the reference repo (the 16-GPU
+results are speedups, BASELINE.md), so vs_baseline = ours / 266.7.
+
+The full training step — forward, backward, SGD+momentum update — runs as
+one jit-compiled XLA program, the same path `caffe train` uses.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _ROOT)
+
+BASELINE_IMG_S = 256 * 20 / 19.2  # K40 + cuDNN, reference docs
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from caffe_mpi_tpu.proto import NetParameter, SolverParameter
+    from caffe_mpi_tpu.solver import Solver
+
+    batch = 256
+    sp = SolverParameter.from_file(
+        os.path.join(_ROOT, "models/alexnet/solver.prototxt"))
+    sp.max_iter = 10**9
+    sp.display = 0
+    sp.snapshot = 0
+    sp.test_interval = 0
+    solver = Solver(sp, model_dir=_ROOT)
+
+    r = np.random.RandomState(0)
+    feeds = {
+        "data": jnp.asarray(r.randn(batch, 3, 227, 227).astype(np.float32)),
+        "label": jnp.asarray(r.randint(0, 1000, batch)),
+    }
+    feed_fn = lambda it: feeds
+
+    # warmup (compile + first steps)
+    solver.step(3, feed_fn)
+    jax.block_until_ready(solver.params)
+
+    iters = 20
+    t0 = time.perf_counter()
+    solver.step(iters, feed_fn)
+    jax.block_until_ready(solver.params)
+    dt = time.perf_counter() - t0
+
+    img_s = batch * iters / dt
+    print(json.dumps({
+        "metric": "alexnet_b256_train_img_per_s_1chip_f32",
+        "value": round(img_s, 1),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

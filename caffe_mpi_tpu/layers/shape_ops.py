@@ -118,7 +118,7 @@ class ReshapeLayer(Layer):
             else:
                 mid.append(d)
         if infer >= 0:
-            known = math.prod([d for d in mid if d != -1]) * math.prod(head + tail) if False else math.prod([d for d in mid if d != -1])
+            known = math.prod([d for d in mid if d != -1])
             total_mid = math.prod(mid_in)
             if known == 0 or total_mid % known:
                 raise ValueError(f"{self.name}: cannot infer -1 dimension")

@@ -13,6 +13,7 @@ from typing import Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..ops.conv import conv2d, conv_output_dim, deconv2d, im2col
@@ -163,7 +164,7 @@ class LRNLayer(Layer):
         if self.region == "WITHIN_CHANNEL":
             # spatial window, divisor is the full window size (lrn pads with 0)
             window_sum = lax.reduce_window(
-                sq, jnp.zeros((), x.dtype), lax.add,
+                sq, np.zeros((), np.dtype(x.dtype))[()], lax.add,
                 window_dimensions=(1, 1, p.local_size, p.local_size),
                 window_strides=(1, 1, 1, 1),
                 padding=((0, 0), (0, 0), (half, half), (half, half)),
@@ -172,7 +173,7 @@ class LRNLayer(Layer):
         else:
             # across channels: 1-D window over C
             window_sum = lax.reduce_window(
-                sq, jnp.zeros((), x.dtype), lax.add,
+                sq, np.zeros((), np.dtype(x.dtype))[()], lax.add,
                 window_dimensions=(1, p.local_size, 1, 1),
                 window_strides=(1, 1, 1, 1),
                 padding=((0, 0), (half, half), (0, 0), (0, 0)),

@@ -76,8 +76,11 @@ class Net:
             if lp.type in ("Data", "ImageData") and batch_divisor > 1:
                 self._divide_batch(lp, batch_divisor)
             layer = create_layer(lp, policy, phase)
-            if lp.type == "Data" and data_shape_probe is not None:
-                layer.bound_shape = data_shape_probe(lp)
+            if data_shape_probe is not None:
+                if lp.type == "Data":
+                    layer.bound_shape = data_shape_probe(lp)
+                elif lp.type == "HDF5Data":
+                    layer.bound_shapes = data_shape_probe(lp)
             # resolve bottoms
             in_shapes = []
             for b in lp.bottom:

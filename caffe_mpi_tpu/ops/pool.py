@@ -62,8 +62,10 @@ def avg_pool2d(x: jnp.ndarray, kernel: tuple[int, int], stride: tuple[int, int],
     ow = pool_output_dim(w, kernel[1], pad[1], stride[1])
     ph = _pad_amounts(h, kernel[0], pad[0], stride[0], oh)
     pw = _pad_amounts(w, kernel[1], pad[1], stride[1], ow)
+    # init must be a CONCRETE scalar: a traced jnp scalar becomes an unknown
+    # operand that breaks reverse-mode linearization of reduce_window
     sums = lax.reduce_window(
-        x, jnp.zeros((), x.dtype), lax.add,
+        x, np.zeros((), x.dtype)[()], lax.add,
         window_dimensions=(1, 1, *kernel),
         window_strides=(1, 1, *stride),
         padding=((0, 0), (0, 0), ph, pw),

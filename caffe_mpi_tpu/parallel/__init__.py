@@ -1,0 +1,1 @@
+from .mesh import MeshPlan, init_distributed, node_count, node_rank

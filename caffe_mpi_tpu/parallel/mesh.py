@@ -1,0 +1,111 @@
+"""Device mesh + data-parallel sharding — the TPU replacement for the
+reference's MPI+NCCL distributed backend.
+
+Reference wire protocol (SURVEY §5.8; src/caffe/parallel.cpp, clusters.cpp):
+mpirun launches one process per node; rank 0 MPI_Bcasts a ncclUniqueId; a
+global NCCL communicator allreduces gradient buckets on a dedicated stream,
+overlapped with backward by a reduce thread; weights ncclBcast from rank 0
+at start.
+
+TPU-native equivalent implemented here:
+- `Clusters` -> `init_distributed()` = jax.distributed.initialize (DCN),
+  after which every host sees the global device list.
+- ncclUniqueId handshake -> nothing: the TPU runtime already forms the
+  ICI/DCN topology.
+- per-GPU P2PSync threads -> SPMD: ONE jitted program over a
+  jax.sharding.Mesh; XLA partitions it across all chips.
+- weight broadcast -> replicated NamedSharding on params (device_put once).
+- bucketed ncclAllReduce + reduce thread -> XLA inserts all-reduces for the
+  gradient mean when the batch axis is sharded and params are replicated;
+  its latency-hiding scheduler overlaps them with remaining backward
+  compute, which is exactly the reference's reduce-thread/bucket overlap
+  machinery (net.cpp:757-913) done by the compiler.
+- divide_batch_size (parallel.cpp:295-348) -> the global batch is sharded
+  over the 'data' axis; each chip sees batch/n_data examples.
+
+The mesh also carries a 'model' axis so later tensor/pipeline-parallel
+shardings slot in without changing this module's API.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+log = logging.getLogger("caffe_mpi_tpu.parallel")
+
+
+def init_distributed(coordinator: str | None = None, num_processes: int | None = None,
+                     process_id: int | None = None) -> None:
+    """Multi-host init (reference Clusters::Init / MPI_Init,
+    clusters.cpp:8-12). On single-host this is a no-op; under a multi-host
+    launcher either the TPU runtime autodetects or the caller passes
+    coordinator/num_processes/process_id explicitly."""
+    if num_processes is not None and num_processes > 1:
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+        log.info("jax.distributed initialized: process %d/%d",
+                 jax.process_index(), jax.process_count())
+
+
+def node_rank() -> int:
+    """Reference Clusters::node_rank."""
+    return jax.process_index()
+
+
+def node_count() -> int:
+    """Reference Clusters::node_count."""
+    return jax.process_count()
+
+
+@dataclass
+class MeshPlan:
+    """A mesh plus the sharding rules the solver uses."""
+
+    mesh: Mesh
+
+    @classmethod
+    def data_parallel(cls, devices=None) -> "MeshPlan":
+        """All devices on the 'data' axis — the reference's (only) strategy."""
+        devs = np.asarray(devices if devices is not None else jax.devices())
+        return cls(mesh=Mesh(devs.reshape(-1, 1), ("data", "model")))
+
+    @classmethod
+    def from_shape(cls, data: int, model: int = 1, devices=None) -> "MeshPlan":
+        devs = np.asarray(devices if devices is not None else jax.devices())
+        if devs.size != data * model:
+            raise ValueError(
+                f"mesh {data}x{model} needs {data * model} devices, "
+                f"have {devs.size}")
+        return cls(mesh=Mesh(devs.reshape(data, model), ("data", "model")))
+
+    @property
+    def n_data(self) -> int:
+        return self.mesh.shape["data"]
+
+    # -- shardings ------------------------------------------------------
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def batch_sharded(self, ndim: int, axis: int = 0) -> NamedSharding:
+        spec = [None] * ndim
+        spec[axis] = "data"
+        return NamedSharding(self.mesh, P(*spec))
+
+    def shard_feeds(self, feeds, batch_axis: int = 0):
+        """device_put a feed pytree with the batch axis sharded over 'data'.
+        Batch dims must divide n_data (the reference rounds up with a
+        warning, parallel.cpp:284-293; here sharding requires exactness)."""
+        def put(x):
+            return jax.device_put(x, self.batch_sharded(x.ndim, batch_axis))
+        return jax.tree.map(put, feeds)
+
+    def replicate(self, tree):
+        """Broadcast params/state to every device (the reference's startup
+        ncclBcast of all weights, parallel.cpp:208-227)."""
+        return jax.device_put(tree, self.replicated())

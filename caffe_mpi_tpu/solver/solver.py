@@ -65,10 +65,15 @@ def _load_net_param(sp: SolverParameter, phase: str, model_dir: str = "",
 class Solver:
     def __init__(self, sp: SolverParameter, *, model_dir: str = "",
                  batch_divisor: int = 1, grad_transform=None,
-                 data_shape_probe=None, rank: int = 0):
+                 data_shape_probe=None, rank: int = 0, mesh=None):
         """grad_transform: hook applied to the grad pytree inside the jitted
-        step — the distributed layer passes lambda g: psum(g)/n here, playing
-        the role of the reference's P2PSync::allreduce callback."""
+        step — a custom distributed layer can pass lambda g: psum(g)/n here,
+        playing the role of the reference's P2PSync::allreduce callback.
+
+        mesh: a parallel.MeshPlan. When set, training runs SPMD over the
+        mesh: params/opt state replicated, feed batches sharded over the
+        'data' axis, XLA inserting and overlapping the gradient all-reduce
+        (the whole reference parallel.cpp machinery)."""
         self.sp = sp
         self.type = solver_type(sp)
         if self.type not in UPDATE_FNS:
@@ -91,9 +96,16 @@ class Solver:
         self.base_rng = jax.random.PRNGKey(seed)
         self.params, self.net_state = self.net.init(self.base_rng)
         self.opt_state = self._init_opt_state()
+        self.mesh = mesh
+        if mesh is not None:
+            # startup weight broadcast (reference parallel.cpp:208-227)
+            self.params = mesh.replicate(self.params)
+            self.net_state = mesh.replicate(self.net_state)
+            self.opt_state = mesh.replicate(self.opt_state)
         self.iter = 0
         self._loss_window = deque(maxlen=max(sp.average_loss, 1))
         self._step_jit = None
+        self._test_fwd_jits: dict[int, Callable] = {}
         self._grad_transform = grad_transform
         # decls (lr_mult/decay_mult per param) in pytree-congruent form
         self._decls = {
@@ -216,6 +228,10 @@ class Solver:
             micro_feeds = [feed_fn(self.iter * iter_size + k)
                            for k in range(iter_size)]
             feeds_stack = jax.tree.map(lambda *xs: jnp.stack(xs), *micro_feeds)
+            if self.mesh is not None:
+                # global batch sharded over the 'data' mesh axis
+                # (divide_batch_size semantics, parallel.cpp:295-348)
+                feeds_stack = self.mesh.shard_feeds(feeds_stack, batch_axis=1)
             rng = jax.random.fold_in(self.base_rng, self.iter + 1)
             it = jnp.int32(self.iter)
             (self.params, self.net_state, self.opt_state, loss,
@@ -258,8 +274,11 @@ class Solver:
         for ti, tnet in enumerate(self.test_nets):
             iters = self.sp.test_iter[ti] if ti < len(self.sp.test_iter) else 50
             feed_fn = test_feed_fns[ti]
-            fwd = jax.jit(lambda p, s, f, tnet=tnet: tnet.apply(
-                p, s, f, train=False)[0])
+            if ti not in self._test_fwd_jits:
+                self._test_fwd_jits[ti] = jax.jit(
+                    lambda p, s, f, tnet=tnet: tnet.apply(p, s, f,
+                                                          train=False)[0])
+            fwd = self._test_fwd_jits[ti]
             # test nets share the train net's weights by layer name
             # (reference ShareTrainedLayersWith)
             scores: dict[str, float] = {}

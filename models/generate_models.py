@@ -1,0 +1,295 @@
+#!/usr/bin/env python
+"""Model zoo generator — programmatically emits the prototxt zoo using
+NetSpec (the reference keeps equivalent python generators in
+models/modelBuilder/). Run from the repo root:
+
+    python models/generate_models.py
+
+Topologies follow the reference zoo: bvlc_alexnet, CIFAR-10 quick,
+GoogLeNet (inception v1), ResNet-50 (bottleneck [3,4,6,3], NVCaffe
+fused-scale BatchNorm). Inputs are Input layers (feed-based); the data
+pipeline binds real datasets at run time.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from caffe_mpi_tpu.net_spec import L, NetSpec
+
+
+def train_test_tail(n, logits, include_train_loss=True):
+    n.loss = L.SoftmaxWithLoss(logits, n.label,
+                               include=dict(phase="TRAIN"))
+    n.accuracy = L.Accuracy(logits, n.label, include=dict(phase="TEST"))
+    n.accuracy_top5 = L.Accuracy(logits, n.label, top_k=5,
+                                 include=dict(phase="TEST"))
+
+
+def conv_relu(bottom, nout, ks, stride=1, pad=0, group=1):
+    c = L.Convolution(bottom, num_output=nout, kernel_size=ks, stride=stride,
+                      pad=pad, group=group,
+                      weight_filler=dict(type="gaussian", std=0.01),
+                      bias_filler=dict(type="constant"),
+                      param=[dict(lr_mult=1, decay_mult=1),
+                             dict(lr_mult=2, decay_mult=0)])
+    return c, L.ReLU(c, in_place=True)
+
+
+def alexnet(batch=256):
+    """bvlc_alexnet topology (reference models/bvlc_alexnet)."""
+    n = NetSpec("AlexNet")
+    n.data, n.label = L.Input(ntop=2, input_param=dict(
+        shape=[dict(dim=[batch, 3, 227, 227]), dict(dim=[batch])]))
+    n.conv1, n.relu1 = conv_relu(n.data, 96, 11, stride=4)
+    n.norm1 = L.LRN(n.relu1, local_size=5, alpha=1e-4, beta=0.75)
+    n.pool1 = L.Pooling(n.norm1, pool="MAX", kernel_size=3, stride=2)
+    n.conv2, n.relu2 = conv_relu(n.pool1, 256, 5, pad=2, group=2)
+    n.norm2 = L.LRN(n.relu2, local_size=5, alpha=1e-4, beta=0.75)
+    n.pool2 = L.Pooling(n.norm2, pool="MAX", kernel_size=3, stride=2)
+    n.conv3, n.relu3 = conv_relu(n.pool2, 384, 3, pad=1)
+    n.conv4, n.relu4 = conv_relu(n.relu3, 384, 3, pad=1, group=2)
+    n.conv5, n.relu5 = conv_relu(n.relu4, 256, 3, pad=1, group=2)
+    n.pool5 = L.Pooling(n.relu5, pool="MAX", kernel_size=3, stride=2)
+    n.fc6 = L.InnerProduct(n.pool5, num_output=4096,
+                           weight_filler=dict(type="gaussian", std=0.005),
+                           bias_filler=dict(type="constant", value=0.1))
+    n.relu6 = L.ReLU(n.fc6, in_place=True)
+    n.drop6 = L.Dropout(n.fc6, dropout_ratio=0.5, in_place=True)
+    n.fc7 = L.InnerProduct(n.fc6, num_output=4096,
+                           weight_filler=dict(type="gaussian", std=0.005),
+                           bias_filler=dict(type="constant", value=0.1))
+    n.relu7 = L.ReLU(n.fc7, in_place=True)
+    n.drop7 = L.Dropout(n.fc7, dropout_ratio=0.5, in_place=True)
+    n.fc8 = L.InnerProduct(n.fc7, num_output=1000,
+                           weight_filler=dict(type="gaussian", std=0.01),
+                           bias_filler=dict(type="constant"))
+    train_test_tail(n, n.fc8)
+    return n
+
+
+def cifar10_quick(batch=100):
+    """CIFAR-10 quick (reference examples/cifar10)."""
+    n = NetSpec("CIFAR10_quick")
+    n.data, n.label = L.Input(ntop=2, input_param=dict(
+        shape=[dict(dim=[batch, 3, 32, 32]), dict(dim=[batch])]))
+    n.conv1 = L.Convolution(n.data, num_output=32, kernel_size=5, pad=2,
+                            weight_filler=dict(type="gaussian", std=0.0001),
+                            param=[dict(lr_mult=1), dict(lr_mult=2)])
+    n.pool1 = L.Pooling(n.conv1, pool="MAX", kernel_size=3, stride=2)
+    n.relu1 = L.ReLU(n.pool1, in_place=True)
+    n.conv2 = L.Convolution(n.pool1, num_output=32, kernel_size=5, pad=2,
+                            weight_filler=dict(type="gaussian", std=0.01),
+                            param=[dict(lr_mult=1), dict(lr_mult=2)])
+    n.relu2 = L.ReLU(n.conv2, in_place=True)
+    n.pool2 = L.Pooling(n.conv2, pool="AVE", kernel_size=3, stride=2)
+    n.conv3 = L.Convolution(n.pool2, num_output=64, kernel_size=5, pad=2,
+                            weight_filler=dict(type="gaussian", std=0.01),
+                            param=[dict(lr_mult=1), dict(lr_mult=2)])
+    n.relu3 = L.ReLU(n.conv3, in_place=True)
+    n.pool3 = L.Pooling(n.conv3, pool="AVE", kernel_size=3, stride=2)
+    n.ip1 = L.InnerProduct(n.pool3, num_output=64,
+                           weight_filler=dict(type="gaussian", std=0.1),
+                           param=[dict(lr_mult=1), dict(lr_mult=2)])
+    n.ip2 = L.InnerProduct(n.ip1, num_output=10,
+                           weight_filler=dict(type="gaussian", std=0.1),
+                           param=[dict(lr_mult=1), dict(lr_mult=2)])
+    train_test_tail(n, n.ip2)
+    return n
+
+
+def inception(n, name, bottom, o1, o3r, o3, o5r, o5, op):
+    """GoogLeNet inception module."""
+    def cr(branch, b, nout, ks, pad=0):
+        c = L.Convolution(b, num_output=nout, kernel_size=ks, pad=pad,
+                          weight_filler=dict(type="xavier"),
+                          bias_filler=dict(type="constant", value=0.2),
+                          param=[dict(lr_mult=1, decay_mult=1),
+                                 dict(lr_mult=2, decay_mult=0)])
+        r = L.ReLU(c, in_place=True)
+        setattr(n, f"{name}_{branch}", c)
+        setattr(n, f"{name}_relu_{branch}", r)
+        return r
+
+    c1 = cr("1x1", bottom, o1, 1)
+    c3r = cr("3x3_reduce", bottom, o3r, 1)
+    c3 = cr("3x3", c3r, o3, 3, pad=1)
+    c5r = cr("5x5_reduce", bottom, o5r, 1)
+    c5 = cr("5x5", c5r, o5, 5, pad=2)
+    pool = L.Pooling(bottom, pool="MAX", kernel_size=3, stride=1, pad=1)
+    setattr(n, f"{name}_pool", pool)
+    cp = cr("pool_proj", pool, op, 1)
+    out = L.Concat(c1, c3, c5, cp)
+    setattr(n, f"{name}_output", out)
+    return out
+
+
+def googlenet(batch=128):
+    """bvlc_googlenet topology (reference models/bvlc_googlenet), without
+    the aux classifier heads (NVCaffe's training recipe also drops them
+    for large-batch runs)."""
+    n = NetSpec("GoogLeNet")
+    n.data, n.label = L.Input(ntop=2, input_param=dict(
+        shape=[dict(dim=[batch, 3, 224, 224]), dict(dim=[batch])]))
+    n.conv1, n.conv1_relu = conv_relu(n.data, 64, 7, stride=2, pad=3)
+    n.pool1 = L.Pooling(n.conv1_relu, pool="MAX", kernel_size=3, stride=2)
+    n.norm1 = L.LRN(n.pool1, local_size=5, alpha=1e-4, beta=0.75)
+    n.conv2_reduce, n.conv2_reduce_relu = conv_relu(n.norm1, 64, 1)
+    n.conv2, n.conv2_relu = conv_relu(n.conv2_reduce_relu, 192, 3, pad=1)
+    n.norm2 = L.LRN(n.conv2_relu, local_size=5, alpha=1e-4, beta=0.75)
+    n.pool2 = L.Pooling(n.norm2, pool="MAX", kernel_size=3, stride=2)
+    x = inception(n, "inception_3a", n.pool2, 64, 96, 128, 16, 32, 32)
+    x = inception(n, "inception_3b", x, 128, 128, 192, 32, 96, 64)
+    n.pool3 = L.Pooling(x, pool="MAX", kernel_size=3, stride=2)
+    x = inception(n, "inception_4a", n.pool3, 192, 96, 208, 16, 48, 64)
+    x = inception(n, "inception_4b", x, 160, 112, 224, 24, 64, 64)
+    x = inception(n, "inception_4c", x, 128, 128, 256, 24, 64, 64)
+    x = inception(n, "inception_4d", x, 112, 144, 288, 32, 64, 64)
+    x = inception(n, "inception_4e", x, 256, 160, 320, 32, 128, 128)
+    n.pool4 = L.Pooling(x, pool="MAX", kernel_size=3, stride=2)
+    x = inception(n, "inception_5a", n.pool4, 256, 160, 320, 32, 128, 128)
+    x = inception(n, "inception_5b", x, 384, 192, 384, 48, 128, 128)
+    n.pool5 = L.Pooling(x, pool="AVE", global_pooling=True)
+    n.drop5 = L.Dropout(n.pool5, dropout_ratio=0.4, in_place=True)
+    n.loss3_classifier = L.InnerProduct(
+        n.pool5, num_output=1000, weight_filler=dict(type="xavier"),
+        bias_filler=dict(type="constant"),
+        param=[dict(lr_mult=1, decay_mult=1), dict(lr_mult=2, decay_mult=0)])
+    train_test_tail(n, n.loss3_classifier)
+    return n
+
+
+def resnet50(batch=32, bf16=False):
+    """ResNet-50, bottleneck [3,4,6,3], NVCaffe fused-scale BatchNorm
+    (reference models/resnet50/train_val.prototxt)."""
+    n = NetSpec("ResNet50")
+    n.data, n.label = L.Input(ntop=2, input_param=dict(
+        shape=[dict(dim=[batch, 3, 224, 224]), dict(dim=[batch])]))
+
+    def conv_bn(b, nout, ks, stride=1, pad=0, relu=True):
+        c = L.Convolution(b, num_output=nout, kernel_size=ks, stride=stride,
+                          pad=pad, bias_term=False,
+                          weight_filler=dict(type="msra"),
+                          param=[dict(lr_mult=1, decay_mult=1)])
+        bn = L.BatchNorm(c, scale_bias=True, eps=1e-5,
+                         moving_average_fraction=0.9)
+        if relu:
+            return L.ReLU(bn, in_place=True), bn
+        return bn, bn
+
+    def bottleneck(b, nout, stride, project):
+        if project:
+            sc, _ = conv_bn(b, nout * 4, 1, stride=stride, relu=False)
+        else:
+            sc = b
+        x, _ = conv_bn(b, nout, 1, stride=stride)
+        x, _ = conv_bn(x, nout, 3, pad=1)
+        x, _ = conv_bn(x, nout * 4, 1, relu=False)
+        s = L.Eltwise(sc, x, operation="SUM")
+        return L.ReLU(s, in_place=True)
+
+    x, _ = conv_bn(n.data, 64, 7, stride=2, pad=3)
+    n.conv1 = x
+    n.pool1 = L.Pooling(x, pool="MAX", kernel_size=3, stride=2)
+    x = n.pool1
+    stages = [(64, 3), (128, 4), (256, 6), (512, 3)]
+    for si, (nout, blocks) in enumerate(stages):
+        for bi in range(blocks):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            x = bottleneck(x, nout, stride, project=(bi == 0))
+            setattr(n, f"res{si + 2}{chr(ord('a') + bi)}", x)
+    n.pool5 = L.Pooling(x, pool="AVE", global_pooling=True)
+    n.fc1000 = L.InnerProduct(n.pool5, num_output=1000,
+                              weight_filler=dict(type="msra"),
+                              bias_filler=dict(type="constant"),
+                              param=[dict(lr_mult=1, decay_mult=1),
+                                     dict(lr_mult=2, decay_mult=0)])
+    train_test_tail(n, n.fc1000)
+    return n
+
+
+SOLVERS = {
+    "alexnet": """# AlexNet solver (reference models/bvlc_alexnet/solver.prototxt recipe)
+net: "models/alexnet/train_val.prototxt"
+test_iter: 1000
+test_interval: 1000
+base_lr: 0.01
+lr_policy: "step"
+gamma: 0.1
+stepsize: 100000
+display: 20
+max_iter: 450000
+momentum: 0.9
+weight_decay: 0.0005
+snapshot: 10000
+snapshot_prefix: "models/alexnet/caffe_alexnet_train"
+""",
+    "cifar10_quick": """# CIFAR-10 quick solver (reference examples/cifar10 recipe)
+net: "models/cifar10_quick/train_val.prototxt"
+test_iter: 100
+test_interval: 500
+base_lr: 0.001
+momentum: 0.9
+weight_decay: 0.004
+lr_policy: "fixed"
+display: 100
+max_iter: 4000
+snapshot: 4000
+snapshot_prefix: "models/cifar10_quick/cifar10_quick"
+""",
+    "googlenet": """# GoogLeNet solver (reference models/bvlc_googlenet recipe)
+net: "models/googlenet/train_val.prototxt"
+test_iter: 1000
+test_interval: 4000
+base_lr: 0.01
+lr_policy: "poly"
+power: 0.5
+display: 40
+max_iter: 2400000
+momentum: 0.9
+weight_decay: 0.0002
+snapshot: 40000
+snapshot_prefix: "models/googlenet/bvlc_googlenet"
+""",
+    "resnet50": """# ResNet-50 solver (reference models/resnet50/solver.prototxt recipe:
+# poly power=2, momentum 0.9, wd 1e-4; DGX-1-class batch-256 variant uses
+# base_lr 0.2 with warmup)
+net: "models/resnet50/train_val.prototxt"
+test_iter: 1000
+test_interval: 5000
+base_lr: 0.1
+lr_policy: "poly"
+power: 2.0
+rampup_interval: 5000
+rampup_lr: 0.01
+display: 100
+max_iter: 600000
+momentum: 0.9
+weight_decay: 0.0001
+snapshot: 25000
+snapshot_prefix: "models/resnet50/resnet50"
+""",
+}
+
+
+def main():
+    out_root = os.path.dirname(os.path.abspath(__file__))
+    nets = {
+        "alexnet": alexnet(),
+        "cifar10_quick": cifar10_quick(),
+        "googlenet": googlenet(),
+        "resnet50": resnet50(),
+    }
+    for name, spec in nets.items():
+        d = os.path.join(out_root, name)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "train_val.prototxt"), "w") as f:
+            f.write(spec.to_prototxt() + "\n")
+        with open(os.path.join(d, "solver.prototxt"), "w") as f:
+            f.write(SOLVERS[name])
+        print(f"wrote models/{name}/")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""NetSpec (programmatic model authoring) tests — pycaffe net_spec parity."""
+
+import pytest
+
+from caffe_mpi_tpu.net import Net
+from caffe_mpi_tpu.net_spec import L, NetSpec
+from caffe_mpi_tpu.proto import NetParameter
+
+
+class TestNetSpec:
+    def test_basic_roundtrip(self):
+        n = NetSpec("tiny")
+        n.data = L.Input(input_param=dict(shape=dict(dim=[4, 3, 8, 8])))
+        n.conv = L.Convolution(n.data, num_output=2, kernel_size=3,
+                               weight_filler=dict(type="xavier"))
+        n.relu = L.ReLU(n.conv, in_place=True)
+        n.pool = L.Pooling(n.relu, pool="MAX", kernel_size=2, stride=2)
+        net = Net(NetParameter.from_text(n.to_prototxt()), phase="TRAIN")
+        assert [l.lp.type for l in net.layers] == [
+            "Input", "Convolution", "ReLU", "Pooling"]
+        # in-place: ReLU reads and writes blob "conv"
+        relu = net.layers[2].lp
+        assert relu.bottom == ["conv"] and relu.top == ["conv"]
+        assert net.blob_shapes["pool"] == (4, 2, 3, 3)
+
+    def test_multi_top(self):
+        n = NetSpec()
+        n.data, n.label = L.Input(ntop=2, input_param=dict(
+            shape=[dict(dim=[2, 4]), dict(dim=[2])]))
+        n.sm = L.Softmax(n.data)
+        txt = n.to_prototxt()
+        net = NetParameter.from_text(txt)
+        assert net.layer[0].top == ["data", "label"]
+
+    def test_unassigned_inplace_layer_errors(self):
+        n = NetSpec()
+        n.data = L.Input(input_param=dict(shape=dict(dim=[2, 4])))
+        n.ip = L.InnerProduct(n.data, num_output=3)
+        L.ReLU(n.ip, in_place=True)  # discarded — must be caught
+        with pytest.raises(ValueError, match="not reachable"):
+            n.to_prototxt()
+
+    def test_generated_zoo_has_activations(self):
+        """Regression: generators must not silently drop in-place layers."""
+        import os
+        for name, min_relus in [("alexnet", 7), ("googlenet", 50),
+                                ("resnet50", 45), ("cifar10_quick", 3)]:
+            path = f"models/{name}/train_val.prototxt"
+            if not os.path.exists(path):
+                pytest.skip("models not generated")
+            net = NetParameter.from_file(path)
+            relus = sum(1 for l in net.layer if l.type == "ReLU")
+            assert relus >= min_relus, f"{name}: only {relus} ReLUs"

@@ -1,0 +1,13 @@
+from .datasets import (
+    CIFAR10Dataset,
+    Dataset,
+    ImageFolderDataset,
+    LMDBDataset,
+    MNISTDataset,
+    SyntheticDataset,
+    encode_datum,
+    open_dataset,
+    parse_datum,
+)
+from .feeder import Feeder, feeder_from_layer
+from .transformer import DataTransformer

@@ -1,0 +1,280 @@
+"""Dataset backends — the reference's db abstraction + format-specific
+readers, host-side.
+
+Reference: include/caffe/util/db{,_lmdb,_leveldb}.hpp + src/caffe/util/db*.cpp
+(cursor over key->Datum records), plus the dataset conversion tools
+(tools/convert_imageset.cpp writes encoded/raw Datums into LMDB/LevelDB).
+
+Here a dataset is random-access (`__len__` + `get(i) -> (chw_uint8, label)`),
+which subsumes the reference's forward-only cursor and lets the deterministic
+round-robin record partitioning of CursorManager (data_reader.hpp:28-53)
+be an index calculation instead of a cursor-skipping protocol.
+
+LMDB support is gated on the `lmdb` module (not in this image); the same
+Datum wire format is parsed with the in-repo protobuf-wire reader, so LMDBs
+written by the reference's convert_imageset load unchanged where lmdb is
+available.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Protocol
+
+import numpy as np
+
+
+class Dataset(Protocol):
+    def __len__(self) -> int: ...
+    def get(self, index: int) -> tuple[np.ndarray, int]:
+        """Returns (CHW uint8 or float image, integer label)."""
+        ...
+
+
+# ---------------------------------------------------------------------------
+# Datum wire format (reference caffe.proto Datum message, field numbers:
+# 1=channels 2=height 3=width 4=data(bytes) 5=label 6=float_data(rep)
+# 7=encoded(bool))
+# ---------------------------------------------------------------------------
+
+def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def parse_datum(buf: bytes) -> tuple[np.ndarray, int]:
+    """Minimal protobuf-wire Datum parser (no protoc dependency)."""
+    channels = height = width = label = 0
+    data = b""
+    float_data: list[float] = []
+    encoded = False
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+            if field == 1:
+                channels = val
+            elif field == 2:
+                height = val
+            elif field == 3:
+                width = val
+            elif field == 5:
+                label = val - (1 << 64) if val >= 1 << 63 else val
+            elif field == 7:
+                encoded = bool(val)
+        elif wire == 2:
+            size, pos = _read_varint(buf, pos)
+            chunk = buf[pos:pos + size]
+            pos += size
+            if field == 4:
+                data = chunk
+            elif field == 6:  # packed float_data
+                float_data.extend(struct.unpack(f"<{size // 4}f", chunk))
+        elif wire == 5:
+            if field == 6:
+                float_data.append(struct.unpack("<f", buf[pos:pos + 4])[0])
+            pos += 4
+        elif wire == 1:
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+    if encoded:
+        import io
+        from PIL import Image
+        img = np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
+        # PIL gives RGB HWC; Caffe stores BGR — convert for parity with
+        # the reference's OpenCV decode (io.cpp DecodeDatumToCVMat)
+        arr = img[:, :, ::-1].transpose(2, 0, 1)
+    elif data:
+        arr = np.frombuffer(data, np.uint8).reshape(channels, height, width)
+    else:
+        arr = np.asarray(float_data, np.float32).reshape(channels, height, width)
+    return arr, label
+
+
+def encode_datum(arr: np.ndarray, label: int) -> bytes:
+    """Write a raw-bytes Datum (tools/convert_imageset parity, unencoded)."""
+    c, h, w = arr.shape
+    out = bytearray()
+
+    def varint(v: int) -> bytes:
+        b = bytearray()
+        while True:
+            if v < 0x80:
+                b.append(v)
+                return bytes(b)
+            b.append((v & 0x7F) | 0x80)
+            v >>= 7
+
+    def field(num: int, wire: int) -> bytes:
+        return varint((num << 3) | wire)
+
+    out += field(1, 0) + varint(c)
+    out += field(2, 0) + varint(h)
+    out += field(3, 0) + varint(w)
+    raw = arr.astype(np.uint8).tobytes()
+    out += field(4, 2) + varint(len(raw)) + raw
+    out += field(5, 0) + varint(label if label >= 0 else label + (1 << 64))
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# Backends
+# ---------------------------------------------------------------------------
+
+class LMDBDataset:
+    """Reads LMDBs written by the reference's convert_imageset
+    (db_lmdb.cpp). Requires the optional `lmdb` module."""
+
+    def __init__(self, path: str):
+        try:
+            import lmdb
+        except ImportError as e:
+            raise ImportError(
+                "LMDB support requires the 'lmdb' python module, which is "
+                "not installed in this environment"
+            ) from e
+        self.env = lmdb.open(path, readonly=True, lock=False,
+                             readahead=False, meminit=False)
+        with self.env.begin() as txn:
+            self.keys = [k for k, _ in txn.cursor()]
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+    def get(self, index: int) -> tuple[np.ndarray, int]:
+        with self.env.begin() as txn:
+            return parse_datum(txn.get(self.keys[index]))
+
+
+class ImageFolderDataset:
+    """Reads an index file of `relative/path.jpg label` lines (the
+    reference ImageData layer's source format, image_data_layer.cpp)."""
+
+    def __init__(self, source: str, root: str = "", new_height: int = 0,
+                 new_width: int = 0, is_color: bool = True):
+        self.root = root
+        self.new_hw = (new_height, new_width)
+        self.is_color = is_color
+        self.items: list[tuple[str, int]] = []
+        with open(source) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                path, _, label = line.rpartition(" ")
+                self.items.append((path, int(label)))
+
+    def __len__(self) -> int:
+        return len(self.items)
+
+    def get(self, index: int) -> tuple[np.ndarray, int]:
+        from PIL import Image
+        path, label = self.items[index]
+        img = Image.open(os.path.join(self.root, path))
+        img = img.convert("RGB" if self.is_color else "L")
+        if self.new_hw[0] and self.new_hw[1]:
+            img = img.resize((self.new_hw[1], self.new_hw[0]), Image.BILINEAR)
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[None, :, :]
+        else:
+            arr = arr[:, :, ::-1].transpose(2, 0, 1)  # RGB HWC -> BGR CHW
+        return arr, label
+
+
+class MNISTDataset:
+    """Raw idx-format MNIST files (the reference converts these to LMDB via
+    examples/mnist/convert_mnist_data.cpp; here they are read directly)."""
+
+    def __init__(self, images_path: str, labels_path: str):
+        with open(images_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            if magic != 2051:
+                raise ValueError(f"bad MNIST image magic {magic}")
+            self.images = np.frombuffer(f.read(), np.uint8).reshape(n, 1, rows, cols)
+        with open(labels_path, "rb") as f:
+            magic, n2 = struct.unpack(">II", f.read(8))
+            if magic != 2049:
+                raise ValueError(f"bad MNIST label magic {magic}")
+            self.labels = np.frombuffer(f.read(), np.uint8)
+        if n != n2:
+            raise ValueError("image/label count mismatch")
+
+    def __len__(self) -> int:
+        return len(self.labels)
+
+    def get(self, index: int) -> tuple[np.ndarray, int]:
+        return self.images[index], int(self.labels[index])
+
+
+class CIFAR10Dataset:
+    """CIFAR-10 binary batches (examples/cifar10/convert_cifar_data.cpp
+    reads the same 1+3072-byte record format)."""
+
+    RECORD = 1 + 3 * 32 * 32
+
+    def __init__(self, *batch_paths: str):
+        blobs = []
+        for p in batch_paths:
+            with open(p, "rb") as f:
+                raw = np.frombuffer(f.read(), np.uint8)
+            if raw.size % self.RECORD:
+                raise ValueError(f"{p}: not a CIFAR-10 binary batch")
+            blobs.append(raw.reshape(-1, self.RECORD))
+        self.records = np.concatenate(blobs, axis=0)
+
+    def __len__(self) -> int:
+        return len(self.records)
+
+    def get(self, index: int) -> tuple[np.ndarray, int]:
+        rec = self.records[index]
+        label = int(rec[0])
+        img = rec[1:].reshape(3, 32, 32)  # CIFAR binary is RGB CHW
+        return img[::-1], label  # -> BGR for Caffe parity
+
+
+class SyntheticDataset:
+    """Deterministic class-template images — test/bench stand-in."""
+
+    def __init__(self, num: int, shape=(3, 32, 32), classes: int = 10,
+                 seed: int = 0, noise: float = 0.3):
+        self.num = num
+        self.classes = classes
+        self.shape = shape
+        self.noise = noise
+        r = np.random.RandomState(seed)
+        self.templates = r.randint(0, 256, (classes, *shape)).astype(np.uint8)
+
+    def __len__(self) -> int:
+        return self.num
+
+    def get(self, index: int) -> tuple[np.ndarray, int]:
+        label = index % self.classes
+        r = np.random.RandomState(index)
+        img = self.templates[label].astype(np.float32)
+        img = img + self.noise * 255 * r.randn(*self.shape)
+        return np.clip(img, 0, 255).astype(np.uint8), label
+
+
+def open_dataset(backend: str, source: str, **kw) -> Dataset:
+    """db::GetDB analogue (reference db.cpp factory)."""
+    backend = backend.upper()
+    if backend == "LMDB":
+        return LMDBDataset(source)
+    if backend == "LEVELDB":
+        raise NotImplementedError(
+            "LevelDB backend needs the plyvel/leveldb module (not in this "
+            "image); convert with convert_imageset to LMDB or image folders"
+        )
+    raise ValueError(f"unknown db backend {backend!r}")

@@ -1,0 +1,163 @@
+"""Batch feeder — host-side prefetch pipeline.
+
+Reference machinery being replaced (SURVEY §2.5): DataReader's reader+parser
+threads with per-solver round-robin record distribution
+(CursorManager, data_reader.hpp:28-53), BasePrefetchingDataLayer's
+transformer threads with free/full Batch queues (base_data_layer.hpp:100-159),
+and the GPU-side async batch copy.
+
+TPU-native shape: batches are assembled by a thread pool *ahead of* the
+training loop (lookahead window = the free/full queue depth), and the jitted
+step overlaps host->HBM transfer with compute because feeds for step N+1 are
+device_put while step N runs. Record->rank assignment is a pure index
+calculation: global record index for (iteration, slot) is
+  it * global_batch + rank * batch + slot  (mod dataset size)
+which reproduces CursorManager's deterministic striping without cursors.
+Epoch shuffling uses a seed-fixed permutation per epoch (DataCache shuffle,
+data_reader.hpp:55-101).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+
+import numpy as np
+
+from .datasets import Dataset
+from .transformer import DataTransformer
+
+
+class Feeder:
+    def __init__(self, dataset: Dataset, transformer: DataTransformer | None,
+                 batch_size: int, *, rank: int = 0, world: int = 1,
+                 shuffle: bool = False, seed: int = 0, threads: int = 2,
+                 lookahead: int = 3, to_device=None,
+                 top_names: tuple[str, str] = ("data", "label")):
+        """to_device: optional callable(feeds_dict) -> feeds_dict placing
+        arrays (e.g. MeshPlan.shard_feeds); applied on the consumer side.
+        top_names: blob names for the (image, label) tops — from the data
+        layer's prototxt `top:` entries."""
+        self.top_names = top_names
+        self.ds = dataset
+        self.tf = transformer
+        self.batch = batch_size
+        self.rank = rank
+        self.world = world
+        self.shuffle = shuffle
+        self.seed = seed
+        self.lookahead = max(lookahead, 1)
+        self.to_device = to_device
+        self.pool = ThreadPoolExecutor(max_workers=max(threads, 1))
+        self._futures: dict[int, Future] = {}
+        self._lock = threading.Lock()
+        n = len(dataset)
+        if n == 0:
+            raise ValueError("empty dataset")
+        self._size = n
+        self._perm_cache: dict[int, np.ndarray] = {}
+
+    # ------------------------------------------------------------------
+    def _record_index(self, it: int, slot: int) -> int:
+        flat = it * self.batch * self.world + self.rank * self.batch + slot
+        epoch, within = divmod(flat, self._size)
+        if not self.shuffle:
+            return within
+        perm = self._perm_cache.get(epoch)
+        if perm is None:
+            perm = np.random.RandomState(self.seed + epoch).permutation(self._size)
+            with self._lock:
+                self._perm_cache[epoch] = perm
+                # bound the cache
+                for k in sorted(self._perm_cache):
+                    if k < epoch - 2:
+                        del self._perm_cache[k]
+        return int(perm[within])
+
+    def _build_batch(self, it: int) -> dict[str, np.ndarray]:
+        imgs, labels = [], []
+        for slot in range(self.batch):
+            rec = self._record_index(it, slot)
+            img, label = self.ds.get(rec)
+            if self.tf is not None:
+                # per-record RNG: deterministic augmentation independent of
+                # thread scheduling (vs the reference's per-thread RNGs)
+                flat = it * self.batch * self.world + self.rank * self.batch + slot
+                img = self.tf(img, rng=self.tf.record_rng(flat))
+            else:
+                img = np.asarray(img, np.float32)
+            imgs.append(img)
+            labels.append(label)
+        out = {self.top_names[0]: np.stack(imgs)}
+        if len(self.top_names) > 1:
+            out[self.top_names[1]] = np.asarray(labels, np.int32)
+        return out
+
+    # ------------------------------------------------------------------
+    def __call__(self, it: int) -> dict:
+        """feed_fn protocol: return the batch for micro-iteration `it`,
+        scheduling lookahead batches in the background."""
+        with self._lock:
+            for ahead in range(it, it + self.lookahead + 1):
+                if ahead not in self._futures:
+                    self._futures[ahead] = self.pool.submit(self._build_batch,
+                                                            ahead)
+            fut = self._futures.pop(it)
+            # drop stale entries (resume/seek)
+            for k in [k for k in self._futures if k < it]:
+                self._futures.pop(k).cancel()
+        feeds = fut.result()
+        if self.to_device is not None:
+            feeds = self.to_device(feeds)
+        return feeds
+
+    def close(self) -> None:
+        self.pool.shutdown(wait=False, cancel_futures=True)
+
+
+def feeder_from_layer(lp, phase: str, *, rank: int = 0, world: int = 1,
+                      model_dir: str = "") -> Feeder:
+    """Build a Feeder from a Data/ImageData layer's prototxt config — the
+    runner-side binding for DB-backed layers (reference
+    DataLayer::LayerSetUp, data_layer.cpp:118-180)."""
+    import os
+
+    from .datasets import ImageFolderDataset, open_dataset
+
+    tp = lp.transform_param
+    tf = DataTransformer(tp, phase)
+    tops = tuple(lp.top)
+    if lp.type == "Data":
+        p = lp.data_param
+        ds = open_dataset(str(p.backend), os.path.join(model_dir, p.source))
+        shuffle = bool(p.shuffle) and phase == "TRAIN"
+        return Feeder(ds, tf, p.batch_size, rank=rank, world=world,
+                      shuffle=shuffle, top_names=tops,
+                      threads=p.threads or 2)
+    if lp.type == "ImageData":
+        p = lp.image_data_param
+        ds = ImageFolderDataset(os.path.join(model_dir, p.source),
+                                root=p.root_folder,
+                                new_height=p.new_height, new_width=p.new_width,
+                                is_color=p.is_color)
+        return Feeder(ds, tf, p.batch_size, rank=rank, world=world,
+                      shuffle=bool(p.shuffle) and phase == "TRAIN",
+                      top_names=tops)
+    raise ValueError(f"not a pipeline data layer: {lp.type}")
+
+
+def data_shape_probe(lp, model_dir: str = ""):
+    """Open the dataset once to discover record shape, returning the
+    post-transform (C,H,W) — the Net-side binding for Data layers
+    (reference: DataLayer reads one sample in LayerSetUp)."""
+    import os as _os
+
+    from .datasets import open_dataset
+
+    if lp.type == "Data":
+        ds = open_dataset(str(lp.data_param.backend),
+                          _os.path.join(model_dir, lp.data_param.source))
+        img, _ = ds.get(0)
+        tf = DataTransformer(lp.transform_param, "TEST")
+        return tf.output_shape(img.shape)
+    raise ValueError(f"no shape probe for layer type {lp.type}")

@@ -94,6 +94,13 @@ class Layer:
         """Pure forward. Returns (tops: list, new_state: dict)."""
         raise NotImplementedError
 
+    # -- interop -----------------------------------------------------------
+    def caffe_blobs(self) -> list[tuple[str, str]]:
+        """Ordered ('param'|'state', name) pairs matching the reference
+        layer's positional blobs_ vector — the .caffemodel contract.
+        Default: declared params in order (weight, bias for most layers)."""
+        return [("param", n) for n in self.params]
+
     # -- conveniences ------------------------------------------------------
     @property
     def name(self) -> str:

@@ -47,6 +47,16 @@ class BatchNormLayer(Layer):
             "var": jnp.zeros((self.channels,), jnp.float32),
         }
 
+    def caffe_blobs(self):
+        """Reference blob order: mean, var, variance-correction(1),
+        [scale, bias] (batch_norm_layer.cpp:39-60). The correction scalar is
+        synthesized on export and unapplied on import (BVLC models store
+        mean/var scaled by it)."""
+        blobs = [("state", "mean"), ("state", "var"), ("correction", "")]
+        if self.scale_bias:
+            blobs += [("param", "scale"), ("param", "bias")]
+        return blobs
+
     def apply(self, params, state, bottoms, *, train, rng):
         x = self.f(bottoms[0])
         nd = x.ndim

@@ -238,3 +238,75 @@ class Net:
 
     def num_learnable_params(self) -> int:
         return sum(1 for _ in self.learnable_param_decls())
+
+    # -- .caffemodel interop (reference net.cpp:1055-1248) ----------------
+    def export_weights(self, params: Params, state: State
+                       ) -> dict[str, list]:
+        """Params/state -> {layer_name: positional blob list} in the
+        reference's blobs_ order (Net::ToProto)."""
+        import numpy as np
+        out: dict[str, list] = {}
+        for layer in self.layers:
+            blobs = []
+            for kind, pname in layer.caffe_blobs():
+                if kind == "param":
+                    owner = self.param_aliases.get((layer.name, pname),
+                                                   (layer.name, pname))
+                    blobs.append(np.asarray(params[owner[0]][owner[1]],
+                                            np.float32))
+                elif kind == "state":
+                    blobs.append(np.asarray(state[layer.name][pname],
+                                            np.float32))
+                elif kind == "correction":
+                    blobs.append(np.ones((1,), np.float32))
+            if blobs:
+                out[layer.name] = blobs
+        return out
+
+    def import_weights(self, params: Params, state: State,
+                       weights: dict[str, list], strict: bool = False
+                       ) -> tuple[Params, State]:
+        """Load by layer-name matching (Net::CopyTrainedLayersFrom:
+        unmatched layers keep their initialization unless strict)."""
+        import numpy as np
+        import jax.numpy as jnp
+        params = {k: dict(v) for k, v in params.items()}
+        state = {k: dict(v) for k, v in state.items()}
+        matched = set()
+        for layer in self.layers:
+            blobs = weights.get(layer.name)
+            if blobs is None:
+                continue
+            matched.add(layer.name)
+            spec = layer.caffe_blobs()
+            if len(blobs) != len(spec):
+                # tolerate BN scale_bias mismatch: 3 vs 5 blobs
+                spec = spec[: len(blobs)]
+            correction = 1.0
+            for (kind, pname), blob in zip(spec, blobs):
+                if kind == "correction":
+                    c = float(np.asarray(blob).reshape(-1)[0])
+                    # BVLC stores mean/var pre-scaled by the correction
+                    correction = (1.0 / c) if c not in (0.0, 1.0) else 1.0
+            for (kind, pname), blob in zip(spec, blobs):
+                blob = np.asarray(blob, np.float32)
+                if kind == "param":
+                    owner = self.param_aliases.get((layer.name, pname),
+                                                   (layer.name, pname))
+                    cur = params[owner[0]][owner[1]]
+                    if tuple(cur.shape) != tuple(blob.shape):
+                        if blob.size != cur.size:
+                            raise ValueError(
+                                f"layer {layer.name!r} blob {pname!r}: shape "
+                                f"{blob.shape} incompatible with {cur.shape}")
+                        blob = blob.reshape(cur.shape)
+                    params[owner[0]][owner[1]] = jnp.asarray(blob, cur.dtype)
+                elif kind == "state":
+                    cur = state[layer.name][pname]
+                    state[layer.name][pname] = jnp.asarray(
+                        blob.reshape(cur.shape) * correction, cur.dtype)
+        if strict:
+            missing = {l.name for l in self.layers if l.params} - matched
+            if missing:
+                raise ValueError(f"no weights for layers: {sorted(missing)}")
+        return params, state

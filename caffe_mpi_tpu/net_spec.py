@@ -96,6 +96,8 @@ class LayerFn:
         self.ntop = self.kwargs.pop("ntop", 1)
         self.in_place = self.kwargs.pop("in_place", False)
         self.tops = [Top(self, i) for i in range(self.ntop)]
+        # zero-top layers (Silence, HDF5Output) still need a bindable handle
+        self.handle = self.tops[0] if self.tops else Top(self, -1)
         _ALL_FNS.append(weakref.ref(self))
 
     def to_node(self, names: dict[Top, str], autonames: "_AutoNamer") -> PbNode:
@@ -108,8 +110,7 @@ class LayerFn:
             return names[top]
 
         node = PbNode()
-        node.add("name", names[self.tops[0]] if self.tops else
-                 autonames.get(self.type_name))
+        node.add("name", names.get(self.handle) or autonames.get(self.type_name))
         node.add("type", self.type_name)
         for b in self.bottoms:
             node.add("bottom", resolve(b))
@@ -153,6 +154,8 @@ class _Layers:
     def __getattr__(self, type_name: str):
         def fn(*args, **kwargs):
             lf = LayerFn(type_name, args, kwargs)
+            if lf.ntop == 0:
+                return lf.handle  # bindable sentinel for zero-top layers
             return lf.tops[0] if lf.ntop == 1 else tuple(lf.tops)
         return fn
 
@@ -211,9 +214,13 @@ class NetSpec:
         # an attribute (e.g. a discarded in-place ReLU) would vanish from
         # the emitted net — error instead.
         reachable_tops = {t for fn in fns for t in fn.tops}
-        for ref in list(_ALL_FNS):
+        alive = []
+        for ref in _ALL_FNS:
             fn = ref()
-            if fn is None or id(fn) in seen:
+            if fn is None:
+                continue
+            alive.append(ref)
+            if id(fn) in seen:
                 continue
             if any(b in reachable_tops for b in fn.bottoms):
                 raise ValueError(
@@ -222,6 +229,7 @@ class NetSpec:
                     "a NetSpec attribute (unassigned in-place layers are the "
                     "usual cause)"
                 )
+        _ALL_FNS[:] = alive  # prune dead weakrefs
 
         # name every top: named ones by attribute, others from layer name
         names: dict[Top, str] = {}

@@ -315,44 +315,61 @@ class Solver:
         return [t for t in produced if t not in consumed]
 
     # ------------------------------------------------------------------
-    # Snapshot / restore (reference solver.cpp:542-604; native format —
-    # .caffemodel interop lives in caffe_mpi_tpu.io)
+    # Snapshot / restore (reference solver.cpp:542-604): two files —
+    # weights (.caffemodel / .caffemodel.h5, readable by the reference) +
+    # solver state (.solverstate.npz: iter, optimizer history, weights
+    # pointer; the reference uses a SolverState binaryproto).
     def snapshot(self) -> str:
         if self.rank != 0:  # only root writes (solver.cpp:543)
             return ""
+        from .. import io as caffe_io
         prefix = self.sp.snapshot_prefix or "snapshot"
-        path = f"{prefix}_iter_{self.iter}.npz"
-        flat = {}
-        for lname, lp in self.params.items():
-            for pname, arr in lp.items():
-                flat[f"param/{lname}/{pname}"] = np.asarray(arr)
-        for lname, ls in self.net_state.items():
-            for sname, arr in ls.items():
-                flat[f"state/{lname}/{sname}"] = np.asarray(arr)
+        os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
+        weights = self.net.export_weights(self.params, self.net_state)
+        layer_types = {l.name: l.lp.type for l in self.net.layers}
+        if str(self.sp.snapshot_format).upper() == "HDF5":
+            model_path = f"{prefix}_iter_{self.iter}.caffemodel.h5"
+            caffe_io.save_caffemodel_h5(model_path, weights)
+        else:
+            model_path = f"{prefix}_iter_{self.iter}.caffemodel"
+            caffe_io.save_caffemodel(model_path, weights,
+                                     self.net.name, layer_types)
+        state_path = f"{prefix}_iter_{self.iter}.solverstate.npz"
+        flat = {"meta/iter": np.asarray(self.iter),
+                "meta/model": np.asarray(model_path)}
         for lname, lo in self.opt_state.items():
             for pname, slots in lo.items():
                 for si, arr in enumerate(slots):
                     flat[f"opt/{lname}/{pname}/{si}"] = np.asarray(arr)
-        flat["meta/iter"] = np.asarray(self.iter)
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        np.savez(path, **flat)
-        log.info("Snapshotting to %s", path)
-        return path
+        np.savez(state_path, **flat)
+        log.info("Snapshotting to %s + %s", model_path, state_path)
+        return state_path
 
     def restore(self, path: str) -> None:
+        """Resume from a .solverstate.npz (reference Solver::Restore)."""
+        from .. import io as caffe_io
         data = np.load(path)
         self.iter = int(data["meta/iter"])
+        model_path = str(data["meta/model"])
+        self.load_weights(model_path)
         for key in data.files:
             parts = key.split("/")
-            if parts[0] == "param":
-                _, lname, pname = parts
-                self.params[lname][pname] = jnp.asarray(data[key])
-            elif parts[0] == "state":
-                _, lname, sname = parts
-                self.net_state[lname][sname] = jnp.asarray(data[key])
-            elif parts[0] == "opt":
+            if parts[0] == "opt":
                 _, lname, pname, si = parts
                 slots = list(self.opt_state[lname][pname])
                 slots[int(si)] = jnp.asarray(data[key])
                 self.opt_state[lname][pname] = tuple(slots)
+        if self.mesh is not None:
+            self.opt_state = self.mesh.replicate(self.opt_state)
         log.info("Restored solver state from %s (iter %d)", path, self.iter)
+
+    def load_weights(self, path: str) -> None:
+        """Finetune-style weight load (reference `caffe train -weights`)."""
+        from .. import io as caffe_io
+        weights = caffe_io.load_weights(path)
+        self.params, self.net_state = self.net.import_weights(
+            self.params, self.net_state, weights)
+        if self.mesh is not None:
+            self.params = self.mesh.replicate(self.params)
+            self.net_state = self.mesh.replicate(self.net_state)
+        log.info("Loaded weights from %s (%d layers)", path, len(weights))

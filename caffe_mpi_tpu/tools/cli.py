@@ -1,0 +1,290 @@
+"""caffe CLI — train / test / time / device_query.
+
+Reference: tools/caffe.cpp (499 LoC): command registry, gflags (-solver,
+-model, -gpu, -snapshot, -weights, -iterations, -sigint_effect,
+-sighup_effect), signal handling (SIGINT->stop, SIGHUP->snapshot), per-layer
+timing benchmark (`caffe time`, tools/caffe.cpp:328-445).
+
+Usage (gflags-compatible single-dash long flags accepted):
+    python -m caffe_mpi_tpu.tools.cli train -solver solver.prototxt [-weights w.caffemodel | -snapshot s.solverstate.npz] [-gpu all]
+    python -m caffe_mpi_tpu.tools.cli test -model net.prototxt -weights w.caffemodel -iterations 50
+    python -m caffe_mpi_tpu.tools.cli time -model net.prototxt -iterations 50
+    python -m caffe_mpi_tpu.tools.cli device_query
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import time
+
+import numpy as np
+
+log = logging.getLogger("caffe")
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="caffe", description=__doc__)
+    p.add_argument("command",
+                   choices=["train", "test", "time", "device_query"])
+    for flag, kw in [
+        ("solver", dict(default="", help="solver prototxt")),
+        ("model", dict(default="", help="net prototxt")),
+        ("weights", dict(default="", help=".caffemodel[.h5] to load")),
+        ("snapshot", dict(default="", help=".solverstate.npz to resume")),
+        ("gpu", dict(default="", help="'all' = full device mesh, or index")),
+        ("iterations", dict(type=int, default=50)),
+        ("sigint_effect", dict(default="stop", choices=["stop", "snapshot", "none"])),
+        ("sighup_effect", dict(default="snapshot", choices=["stop", "snapshot", "none"])),
+        ("phase", dict(default="TEST", choices=["TRAIN", "TEST"])),
+        ("synthetic", dict(action="store_true",
+                           help="feed random data into Input layers")),
+    ]:
+        p.add_argument(f"-{flag}", f"--{flag}", **kw)
+    return p
+
+
+def _select_mesh(gpu_flag: str):
+    """-gpu all => data-parallel mesh over every device (the reference
+    spawns one P2PSync per GPU; here one SPMD program)."""
+    from ..parallel import MeshPlan
+    if gpu_flag == "all":
+        return MeshPlan.data_parallel()
+    return None
+
+
+def _synthetic_feed(net, seed=0):
+    """Random feeds shaped from the net's Input layers (the reference's
+    `caffe time` uses dummy data the same way)."""
+    import jax.numpy as jnp
+    r = np.random.RandomState(seed)
+    feeds = {}
+    for blob in net.feed_blobs:
+        shape = net.blob_shapes[blob]
+        if blob == "label":
+            feeds[blob] = jnp.asarray(r.randint(0, 10, shape))
+        else:
+            feeds[blob] = jnp.asarray(r.randn(*shape).astype(np.float32))
+    return feeds
+
+
+def _build_feeders(net, phase, rank=0, world=1):
+    """Create a Feeder per DB-backed data layer, or None for Input nets."""
+    from ..data import feeder_from_layer
+    for layer in net.layers:
+        if layer.lp.type in ("Data", "ImageData"):
+            return feeder_from_layer(layer.lp, phase, rank=rank, world=world)
+    return None
+
+
+def cmd_train(args) -> int:
+    from ..proto import SolverParameter
+    from ..solver import Solver
+    if not args.solver:
+        log.error("train requires -solver")
+        return 1
+    import os
+    from ..data.feeder import data_shape_probe
+    sp = SolverParameter.from_file(args.solver)
+    model_dir = os.path.dirname(os.path.abspath(args.solver)) \
+        if not (sp.net and os.path.exists(sp.net)) else ""
+    solver = Solver(sp, mesh=_select_mesh(args.gpu), model_dir=model_dir,
+                    data_shape_probe=lambda lp: data_shape_probe(lp, model_dir))
+    if args.snapshot:
+        solver.restore(args.snapshot)
+    elif args.weights:
+        for w in args.weights.split(","):
+            solver.load_weights(w)
+
+    # signal plumbing (reference SignalHandler, tools/caffe.cpp:209-211):
+    # handlers only set flags; actions run at the iteration boundary —
+    # snapshotting from inside the handler would race the jitted step's
+    # donated buffers
+    state = {"stop": False, "snap": False}
+
+    def on_signal(effect):
+        def handler(sig, frame):
+            if effect == "snapshot":
+                state["snap"] = True
+            elif effect == "stop":
+                state["stop"] = True
+                log.info("signal: stopping after this iteration")
+        return handler
+
+    signal.signal(signal.SIGINT, on_signal(args.sigint_effect))
+    if hasattr(signal, "SIGHUP"):
+        signal.signal(signal.SIGHUP, on_signal(args.sighup_effect))
+
+    feeder = _build_feeders(solver.net, "TRAIN")
+    if feeder is None:
+        if not args.synthetic:
+            log.error("net has no Data layer; pass -synthetic to train on "
+                      "random data or use a Data/ImageData net")
+            return 1
+        feeds = _synthetic_feed(solver.net)
+        feed_fn = lambda it: feeds
+    else:
+        feed_fn = feeder
+
+    test_feed_fns = None
+    if solver.test_nets:
+        tf = []
+        for tnet in solver.test_nets:
+            f = _build_feeders(tnet, "TEST")
+            if f is None:
+                feeds_t = _synthetic_feed(tnet, seed=1)
+                tf.append(lambda it, feeds_t=feeds_t: feeds_t)
+            else:
+                tf.append(f)
+        test_feed_fns = tf
+
+    t0 = time.time()
+    start_iter = solver.iter
+    while solver.iter < sp.max_iter and not state["stop"]:
+        chunk = min(100, sp.max_iter - solver.iter)
+        solver.step(chunk, feed_fn, test_feed_fns)
+        if state["snap"]:
+            state["snap"] = False
+            solver.snapshot()
+    if (state["stop"] and args.sigint_effect == "stop") or (
+            not state["stop"] and sp.snapshot_after_train and sp.snapshot_prefix):
+        solver.snapshot()  # reference snapshots at stop/after-train (solver.cpp:402-407)
+    elapsed = time.time() - t0
+    imgs = (solver.iter - start_iter) * solver._batch_images() * max(sp.iter_size, 1)
+    log.info("Optimization done: %d iters, %.1f s, %.1f img/s overall",
+             solver.iter, elapsed, imgs / max(elapsed, 1e-9))
+    return 0
+
+
+def cmd_test(args) -> int:
+    import jax
+    from ..net import Net
+    from ..proto import NetParameter
+    from .. import io as caffe_io
+    if not args.model:
+        log.error("test requires -model")
+        return 1
+    net = Net(NetParameter.from_file(args.model), phase="TEST")
+    params, state = net.init(jax.random.PRNGKey(0))
+    if args.weights:
+        params, state = net.import_weights(params, state,
+                                           caffe_io.load_weights(args.weights))
+    feeder = _build_feeders(net, "TEST")
+    fwd = jax.jit(lambda p, s, f: net.apply(p, s, f, train=False)[0])
+    consumed = {b for l in net.layers for b in l.lp.bottom}
+    outputs = [t for l in net.layers for t in l.lp.top if t not in consumed]
+    totals: dict[str, float] = {}
+    for it in range(args.iterations):
+        feeds = feeder(it) if feeder else _synthetic_feed(net, seed=it)
+        if feeder:
+            import jax.numpy as jnp
+            feeds = {k: jnp.asarray(v) for k, v in feeds.items()}
+        blobs = fwd(params, state, feeds)
+        for b in outputs:
+            totals[b] = totals.get(b, 0.0) + float(np.mean(np.asarray(blobs[b])))
+    for b in outputs:
+        log.info("%s = %.5g", b, totals[b] / args.iterations)
+        print(f"{b} = {totals[b] / args.iterations:.5g}")
+    return 0
+
+
+def cmd_time(args) -> int:
+    """Per-layer forward/backward timing (reference tools/caffe.cpp:328-445).
+    Per-layer costs come from timing each layer's jitted apply in isolation;
+    whole-graph fwd and fwd+bwd are timed as single fused programs — the
+    number that actually matters on TPU, where XLA fuses across layers."""
+    import jax
+    import jax.numpy as jnp
+    from ..net import Net
+    from ..proto import NetParameter
+    if not args.model:
+        log.error("time requires -model")
+        return 1
+    net = Net(NetParameter.from_file(args.model), phase=args.phase)
+    params, state = net.init(jax.random.PRNGKey(0))
+    feeds = _synthetic_feed(net)
+
+    # materialize every blob once to get per-layer inputs
+    blobs, _, _ = net.apply(params, state, feeds, train=False)
+    blobs = dict(blobs)
+    rows = []
+    iters = max(args.iterations, 1)
+    for layer in net.layers:
+        from ..layers.data_layers import InputLayerBase
+        if isinstance(layer, InputLayerBase):
+            continue
+        bottoms = [blobs[b] for b in layer.lp.bottom]
+        lparams = net._layer_params(layer, params, False)
+        lstate = state.get(layer.name, {})
+        fn = jax.jit(lambda p, s, bs, layer=layer: layer.apply(
+            p, s, bs, train=False, rng=None)[0])
+        out = fn(lparams, lstate, bottoms)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(lparams, lstate, bottoms)
+        jax.block_until_ready(out)
+        ms = (time.perf_counter() - t0) / iters * 1e3
+        rows.append((layer.name, layer.lp.type, ms))
+
+    def whole(train):
+        rng_key = jax.random.PRNGKey(0)
+
+        def f(p, s, fd):
+            _, _, loss = net.apply(p, s, fd, train=train,
+                                   rng=rng_key if train else None)
+            return loss
+        if train:
+            g = jax.jit(jax.grad(f))
+        else:
+            g = jax.jit(f)
+        out = g(params, state, feeds)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = g(params, state, feeds)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    fwd_ms = whole(False)
+    total_ms = whole(True) if net.loss_blobs else float("nan")
+    print(f"{'layer':<28}{'type':<20}{'fwd ms (isolated)':>18}")
+    for name, tname, ms in rows:
+        print(f"{name:<28}{tname:<20}{ms:>18.3f}")
+    print(f"\nwhole-graph forward (fused): {fwd_ms:.3f} ms")
+    print(f"whole-graph forward+backward (fused): {total_ms:.3f} ms")
+    print(f"sum of isolated per-layer fwd: {sum(r[2] for r in rows):.3f} ms "
+          "(>= fused time; the gap is XLA fusion)")
+    return 0
+
+
+def cmd_device_query(args) -> int:
+    import jax
+    for d in jax.devices():
+        print(f"device {d.id}: {d.device_kind} platform={d.platform} "
+              f"process={d.process_index}")
+        mem = getattr(d, "memory_stats", lambda: None)()
+        if mem:
+            print(f"  hbm: {mem.get('bytes_limit', 0) / 2**30:.1f} GiB limit, "
+                  f"{mem.get('bytes_in_use', 0) / 2**20:.1f} MiB in use")
+    return 0
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(levelname).1s%(asctime)s %(name)s] %(message)s",
+        datefmt="%m%d %H:%M:%S")
+    args = _parser().parse_args(argv)
+    return {
+        "train": cmd_train,
+        "test": cmd_test,
+        "time": cmd_time,
+        "device_query": cmd_device_query,
+    }[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,6 +40,17 @@ class TestNetSpec:
         with pytest.raises(ValueError, match="not reachable"):
             n.to_prototxt()
 
+    def test_zero_top_layer(self):
+        n = NetSpec()
+        n.data, n.label = L.Input(ntop=2, input_param=dict(
+            shape=[dict(dim=[2, 4]), dict(dim=[2])]))
+        n.silence = L.Silence(n.label, ntop=0)
+        txt = n.to_prototxt()
+        net = NetParameter.from_text(txt)
+        sil = [l for l in net.layer if l.type == "Silence"][0]
+        assert sil.bottom == ["label"] and sil.top == []
+        assert sil.name == "silence"
+
     def test_generated_zoo_has_activations(self):
         """Regression: generators must not silently drop in-place layers."""
         import os

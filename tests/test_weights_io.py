@@ -1,0 +1,95 @@
+"""Weight interop tests: export/import through .caffemodel (binary wire) and
+HDF5, including BatchNorm's positional-blob contract and the BVLC
+variance-correction convention."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from caffe_mpi_tpu.io import (
+    load_caffemodel,
+    load_caffemodel_h5,
+    save_caffemodel,
+    save_caffemodel_h5,
+)
+from caffe_mpi_tpu.net import Net
+from caffe_mpi_tpu.proto import NetParameter
+
+NET = """
+name: "wio"
+layer { name: "in" type: "Input" top: "x"
+        input_param { shape { dim: 2 dim: 3 dim: 8 dim: 8 } } }
+layer { name: "conv1" type: "Convolution" bottom: "x" top: "c1"
+        convolution_param { num_output: 4 kernel_size: 3 pad: 1
+          weight_filler { type: "msra" } } }
+layer { name: "bn1" type: "BatchNorm" bottom: "c1" top: "c1"
+        batch_norm_param { scale_bias: true } }
+layer { name: "relu1" type: "ReLU" bottom: "c1" top: "c1" }
+layer { name: "ip" type: "InnerProduct" bottom: "c1" top: "y"
+        inner_product_param { num_output: 5
+          weight_filler { type: "xavier" } } }
+"""
+
+
+def build(seed=0):
+    net = Net(NetParameter.from_text(NET), phase="TEST")
+    params, state = net.init(jax.random.PRNGKey(seed))
+    # non-trivial BN stats
+    state["bn1"]["mean"] = jnp.asarray(np.arange(4, dtype=np.float32))
+    state["bn1"]["var"] = jnp.asarray(np.arange(1, 5, dtype=np.float32))
+    return net, params, state
+
+
+class TestWeightRoundTrip:
+    @pytest.mark.parametrize("fmt", ["binary", "h5"])
+    def test_roundtrip_preserves_outputs(self, fmt, tmp_path, rng):
+        net, params, state = build(seed=0)
+        x = jnp.asarray(rng.randn(2, 3, 8, 8).astype(np.float32))
+        blobs, _, _ = net.apply(params, state, {"x": x}, train=False)
+        y_ref = np.array(blobs["y"])
+
+        weights = net.export_weights(params, state)
+        assert len(weights["bn1"]) == 5  # mean, var, correction, scale, bias
+        path = str(tmp_path / f"w.caffemodel{'.h5' if fmt == 'h5' else ''}")
+        if fmt == "h5":
+            save_caffemodel_h5(path, weights)
+            back = load_caffemodel_h5(path)
+        else:
+            save_caffemodel(path, weights, "wio")
+            back = load_caffemodel(path)
+
+        net2, params2, state2 = build(seed=99)  # different init
+        params2, state2 = net2.import_weights(params2, state2, back)
+        blobs2, _, _ = net2.apply(params2, state2, {"x": x}, train=False)
+        np.testing.assert_allclose(np.array(blobs2["y"]), y_ref, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_bvlc_correction_unscaling(self):
+        """BVLC-style BN blobs store mean*corr, var*corr with blobs[2]=corr;
+        import must divide it out (reference batch_norm semantics)."""
+        net, params, state = build()
+        corr = 0.5
+        weights = {
+            "bn1": [np.full(4, 2.0, np.float32) * corr,      # mean * corr
+                    np.full(4, 3.0, np.float32) * corr,      # var * corr
+                    np.asarray([corr], np.float32),
+                    np.ones(4, np.float32), np.zeros(4, np.float32)],
+        }
+        params2, state2 = net.import_weights(params, state, weights)
+        np.testing.assert_allclose(np.array(state2["bn1"]["mean"]), 2.0)
+        np.testing.assert_allclose(np.array(state2["bn1"]["var"]), 3.0)
+
+    def test_unmatched_layers_keep_init(self):
+        net, params, state = build()
+        w0 = np.array(params["conv1"]["weight"])
+        params2, _ = net.import_weights(params, state, {"ip": [
+            np.ones((5, 256), np.float32), np.zeros(5, np.float32)]})
+        np.testing.assert_array_equal(np.array(params2["conv1"]["weight"]), w0)
+        np.testing.assert_array_equal(np.array(params2["ip"]["weight"]), 1.0)
+
+    def test_shape_mismatch_raises(self):
+        net, params, state = build()
+        with pytest.raises(ValueError, match="incompatible"):
+            net.import_weights(params, state,
+                               {"ip": [np.ones((7, 99), np.float32)]})

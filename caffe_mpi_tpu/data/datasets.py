@@ -267,14 +267,61 @@ class SyntheticDataset:
         return np.clip(img, 0, 255).astype(np.uint8), label
 
 
+class DatumFileDataset:
+    """Single-file Datum container. On-disk layout:
+    MAGIC, raw back-to-back Datum messages, then an index:
+    [int64 count][count x (int64 offset, int64 size)][int64 index_offset].
+    Fills the gap when the lmdb module is unavailable; written by
+    tools/convert_imageset with -backend datumfile."""
+
+    MAGIC = b"CAFFEDATUMv1"
+
+    def __init__(self, path: str):
+        self.f = open(path, "rb")
+        self._fd = self.f.fileno()
+        header = self.f.read(len(self.MAGIC))
+        if header != self.MAGIC:
+            raise ValueError(f"{path}: not a datumfile")
+        self.f.seek(-8, os.SEEK_END)
+        index_off = struct.unpack("<q", self.f.read(8))[0]
+        self.f.seek(index_off)
+        count = struct.unpack("<q", self.f.read(8))[0]
+        self.offsets = np.frombuffer(self.f.read(count * 16), "<i8").reshape(-1, 2)
+
+    def __len__(self) -> int:
+        return len(self.offsets)
+
+    def get(self, index: int) -> tuple[np.ndarray, int]:
+        off, size = self.offsets[index]
+        # pread: positioned read, safe under the Feeder's concurrent threads
+        return parse_datum(os.pread(self._fd, int(size), int(off)))
+
+    @classmethod
+    def write(cls, path: str, records) -> int:
+        """records: iterable of encoded Datum bytes."""
+        offsets = []
+        with open(path, "wb") as f:
+            f.write(cls.MAGIC)
+            for buf in records:
+                offsets.append((f.tell(), len(buf)))
+                f.write(buf)
+            index_off = f.tell()
+            f.write(struct.pack("<q", len(offsets)))
+            f.write(np.asarray(offsets, "<i8").tobytes())
+            f.write(struct.pack("<q", index_off))
+        return len(offsets)
+
+
 def open_dataset(backend: str, source: str, **kw) -> Dataset:
     """db::GetDB analogue (reference db.cpp factory)."""
     backend = backend.upper()
     if backend == "LMDB":
         return LMDBDataset(source)
+    if backend == "DATUMFILE":
+        return DatumFileDataset(source)
     if backend == "LEVELDB":
         raise NotImplementedError(
             "LevelDB backend needs the plyvel/leveldb module (not in this "
-            "image); convert with convert_imageset to LMDB or image folders"
+            "image); convert with convert_imageset to LMDB or datumfile"
         )
     raise ValueError(f"unknown db backend {backend!r}")

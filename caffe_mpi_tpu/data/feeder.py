@@ -125,7 +125,7 @@ def feeder_from_layer(lp, phase: str, *, rank: int = 0, world: int = 1,
     from .datasets import ImageFolderDataset, open_dataset
 
     tp = lp.transform_param
-    tf = DataTransformer(tp, phase)
+    tf = DataTransformer(tp, phase, model_dir=model_dir)
     tops = tuple(lp.top)
     if lp.type == "Data":
         p = lp.data_param
@@ -158,6 +158,90 @@ def data_shape_probe(lp, model_dir: str = ""):
         ds = open_dataset(str(lp.data_param.backend),
                           _os.path.join(model_dir, lp.data_param.source))
         img, _ = ds.get(0)
-        tf = DataTransformer(lp.transform_param, "TEST")
+        tf = DataTransformer(lp.transform_param, "TEST", model_dir=model_dir)
         return tf.output_shape(img.shape)
+    if lp.type == "HDF5Data":
+        import h5py
+        src = _os.path.join(model_dir, lp.hdf5_data_param.source)
+        files = _h5_list_files(src)
+        with h5py.File(files[0], "r") as h5:
+            return [tuple(h5[top].shape[1:]) for top in lp.top]
     raise ValueError(f"no shape probe for layer type {lp.type}")
+
+
+def _h5_list_files(source: str) -> list[str]:
+    """Resolve an HDF5 source list: each line is a path, absolute or
+    relative to the list file's directory (reference hdf5_data_layer.cpp)."""
+    import os as _os
+    base = _os.path.dirname(source)
+    out = []
+    with open(source) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(line if _os.path.isabs(line)
+                           else _os.path.join(base, line))
+    if not out:
+        raise ValueError(f"{source}: empty HDF5 source list")
+    return out
+
+
+class HDF5Feeder:
+    """Feeds batches from HDF5 files listed in a source file (reference
+    hdf5_data_layer.cpp: datasets named by the layer's top blobs).
+
+    Files are loaded into preallocated host arrays (single copy); with
+    shuffle enabled, a fresh seed-derived permutation is drawn every epoch,
+    matching the reference's per-pass reshuffle."""
+
+    def __init__(self, lp, *, model_dir: str = "", rank: int = 0,
+                 world: int = 1, seed: int = 1701):
+        import h5py
+        import os as _os
+        p = lp.hdf5_data_param
+        self.batch = p.batch_size
+        self.tops = list(lp.top)
+        self.rank, self.world = rank, world
+        self.shuffle = bool(p.shuffle)
+        self.seed = seed
+        files = _h5_list_files(_os.path.join(model_dir, p.source))
+        # first pass: shapes only; preallocate to avoid a 2x concat copy
+        lengths = []
+        dtypes: dict[str, np.dtype] = {}
+        shapes: dict[str, tuple] = {}
+        for path in files:
+            with h5py.File(path, "r") as h5:
+                lengths.append(len(h5[self.tops[0]]))
+                for t in self.tops:
+                    dtypes[t] = h5[t].dtype
+                    shapes[t] = tuple(h5[t].shape[1:])
+        self.n = sum(lengths)
+        self.arrays = {t: np.empty((self.n, *shapes[t]), dtypes[t])
+                       for t in self.tops}
+        pos = 0
+        for path, ln in zip(files, lengths):
+            with h5py.File(path, "r") as h5:
+                for t in self.tops:
+                    h5[t].read_direct(self.arrays[t],
+                                      dest_sel=np.s_[pos:pos + ln])
+            pos += ln
+        self._perms: dict[int, np.ndarray] = {}
+
+    def _index(self, flat: int) -> int:
+        epoch, within = divmod(flat, self.n)
+        if not self.shuffle:
+            return within
+        perm = self._perms.get(epoch)
+        if perm is None:
+            perm = np.random.RandomState(self.seed + epoch).permutation(self.n)
+            self._perms = {epoch: perm}  # keep only the current epoch
+        return int(perm[within])
+
+    def __call__(self, it: int) -> dict[str, np.ndarray]:
+        idx = [self._index(it * self.batch * self.world
+                           + self.rank * self.batch + k)
+               for k in range(self.batch)]
+        return {t: self.arrays[t][idx] for t in self.tops}
+
+    def close(self) -> None:
+        pass

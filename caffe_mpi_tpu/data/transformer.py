@@ -20,7 +20,8 @@ from ..proto.config import TransformationParameter
 
 class DataTransformer:
     def __init__(self, tp: TransformationParameter | None, phase: str,
-                 seed: int | None = None):
+                 seed: int | None = None, model_dir: str = ""):
+        import os
         self.tp = tp or TransformationParameter()
         self.phase = phase
         if seed is None and self.tp.random_seed >= 0:
@@ -34,7 +35,8 @@ class DataTransformer:
         self.mean: np.ndarray | None = None
         if self.tp.mean_file:
             from ..io import load_blob_binaryproto
-            self.mean = load_blob_binaryproto(self.tp.mean_file)
+            self.mean = load_blob_binaryproto(
+                os.path.join(model_dir, self.tp.mean_file))
             if self.mean.ndim == 4:
                 self.mean = self.mean[0]
         elif self.tp.mean_value:

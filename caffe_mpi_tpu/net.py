@@ -46,11 +46,15 @@ class Net:
     def __init__(self, param: NetParameter, phase: str = "TRAIN", *,
                  level: int = 0, stages: Sequence[str] = (),
                  batch_divisor: int = 1,
-                 data_shape_probe=None):
+                 data_shape_probe=None, model_dir: str = ""):
         """batch_divisor: divide data-layer batch sizes by the per-replica
         count, reproducing divide_batch_size (reference parallel.cpp:295-348).
         data_shape_probe: callable(layer_param) -> (C,H,W) for DB-backed
-        layers whose shape comes from the dataset."""
+        layers whose shape comes from the dataset.
+        model_dir: base directory for relative data-source paths (the
+        directory of the prototxt, like the reference's working-dir
+        convention)."""
+        self.model_dir = model_dir
         param = normalize_net(param)
         state = NetState(phase=phase, level=level, stage=list(stages))
         param = filter_net(param, state)
@@ -76,11 +80,17 @@ class Net:
             if lp.type in ("Data", "ImageData") and batch_divisor > 1:
                 self._divide_batch(lp, batch_divisor)
             layer = create_layer(lp, policy, phase)
-            if data_shape_probe is not None:
+            if lp.type in ("Data", "HDF5Data"):
+                probe = data_shape_probe
+                if probe is None:
+                    # default: open the dataset once to discover shapes
+                    # (reference DataLayer reads a sample in LayerSetUp)
+                    from .data.feeder import data_shape_probe as _default_probe
+                    probe = lambda lp_: _default_probe(lp_, model_dir)
                 if lp.type == "Data":
-                    layer.bound_shape = data_shape_probe(lp)
-                elif lp.type == "HDF5Data":
-                    layer.bound_shapes = data_shape_probe(lp)
+                    layer.bound_shape = probe(lp)
+                else:
+                    layer.bound_shapes = probe(lp)
             # resolve bottoms
             in_shapes = []
             for b in lp.bottom:

@@ -1,0 +1,314 @@
+"""pycaffe-compatible API — `import caffe_mpi_tpu.pycaffe as caffe`.
+
+Reference: python/caffe/_caffe.cpp (boost::python bindings) +
+python/caffe/pycaffe.py: caffe.Net (forward/backward/blobs/params/save/
+copy_from), caffe.SGDSolver (solve/step/snapshot/restore), caffe.Blob with
+numpy data/diff views, set_mode_cpu/gpu, layer_type_list, NetSpec re-export.
+
+Semantics mapping: the reference's mutable Blob.data/.diff numpy views
+become materialized numpy arrays refreshed per forward/backward (functional
+substrate underneath); assignment through `net.blobs['x'].data[...] = v`
+works because the Blob caches the array until the next forward.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .layers.base import registered_types as layer_type_list  # noqa: F401
+from .net import Net as _GraphNet
+from .net_spec import L, NetSpec  # noqa: F401 — pycaffe net_spec parity
+from .proto import NetParameter, SolverParameter
+from . import io as _io
+
+TRAIN, TEST = "TRAIN", "TEST"
+
+
+def set_mode_cpu() -> None:
+    """Reference Caffe::set_mode(CPU). On this framework the platform is
+    chosen by JAX; this forces the CPU backend."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+def set_mode_gpu() -> None:
+    """Reference Caffe::set_mode(GPU) — accept and let JAX pick the
+    accelerator platform (TPU here)."""
+
+
+def set_device(device_id: int) -> None:
+    """Accepted for API parity; device placement is mesh-driven."""
+
+
+class Blob:
+    """Numpy view of a named array (reference _caffe.cpp Blob bindings)."""
+
+    def __init__(self, get, set_=None, diff_get=None):
+        self._get = get
+        self._set = set_
+        self._diff_get = diff_get
+        self._cache: np.ndarray | None = None
+
+    @property
+    def data(self) -> np.ndarray:
+        if self._cache is None:
+            self._cache = np.array(self._get())
+        return self._cache
+
+    @data.setter
+    def data(self, value) -> None:
+        self._cache = np.asarray(value)
+        if self._set:
+            self._set(self._cache)
+
+    def push(self) -> None:
+        if self._cache is not None and self._set:
+            self._set(self._cache)
+
+    @property
+    def diff(self) -> np.ndarray:
+        if self._diff_get is None:
+            raise AttributeError("diff only available after backward()")
+        return np.array(self._diff_get())
+
+    @property
+    def shape(self):
+        return tuple(self.data.shape)
+
+    @property
+    def num(self):
+        return self.shape[0]
+
+    @property
+    def channels(self):
+        return self.shape[1] if len(self.shape) > 1 else 1
+
+
+class Net:
+    """caffe.Net(model_file, phase) or caffe.Net(model_file, weights, phase)."""
+
+    def __init__(self, model_file: str, *args):
+        import jax
+        if len(args) == 1:
+            weights, phase = None, args[0]
+        elif len(args) == 2:
+            weights, phase = args
+        else:
+            raise TypeError("Net(model, [weights,] phase)")
+        self._net = _GraphNet(NetParameter.from_file(model_file), phase=phase)
+        self._params, self._state = self._net.init(jax.random.PRNGKey(0))
+        if weights:
+            self.copy_from(weights)
+        self._blob_values: dict[str, np.ndarray] = {}
+        self._grads = None
+        self._inputs: dict[str, np.ndarray] = {}
+        self._fwd_jit = None
+
+    # -- pycaffe surface -------------------------------------------------
+    @property
+    def inputs(self):
+        return list(self._net.feed_blobs)
+
+    @property
+    def outputs(self):
+        consumed = {b for l in self._net.layers for b in l.lp.bottom}
+        return [t for l in self._net.layers for t in l.lp.top
+                if t not in consumed]
+
+    @property
+    def blobs(self) -> dict[str, Blob]:
+        out = {}
+        for name in self._net.blob_shapes:
+            if name in self._net.feed_blobs:
+                out[name] = Blob(
+                    get=lambda n=name: self._input_value(n),
+                    set_=lambda v, n=name: self._inputs.__setitem__(n, v))
+            else:
+                out[name] = Blob(get=lambda n=name: self._blob_value(n))
+        return out
+
+    @property
+    def params(self) -> dict[str, list[Blob]]:
+        out = {}
+        for layer in self._net.layers:
+            if not layer.params:
+                continue
+            blobs = []
+            for pname in layer.params:
+                owner = self._net.param_aliases.get((layer.name, pname),
+                                                    (layer.name, pname))
+
+                def get(o=owner):
+                    return self._params[o[0]][o[1]]
+
+                def set_(v, o=owner):
+                    import jax.numpy as jnp
+                    cur = self._params[o[0]][o[1]]
+                    self._params[o[0]][o[1]] = jnp.asarray(v, cur.dtype)
+
+                def diff(o=owner):
+                    if self._grads is None:
+                        raise RuntimeError("run backward() first")
+                    return self._grads[o[0]][o[1]]
+
+                blobs.append(Blob(get, set_, diff))
+            out[layer.name] = blobs
+        return out
+
+    @property
+    def layer_dict(self):
+        return {l.name: l for l in self._net.layers}
+
+    def _input_value(self, name):
+        if name not in self._inputs:
+            shape = self._net.blob_shapes[name]
+            self._inputs[name] = np.zeros(shape, np.float32)
+        return self._inputs[name]
+
+    def _blob_value(self, name):
+        if name not in self._blob_values:
+            raise RuntimeError(f"blob {name!r}: run forward() first")
+        return self._blob_values[name]
+
+    def forward(self, blobs=None, **kwargs) -> dict[str, np.ndarray]:
+        """net.forward(data=x) or pre-set net.blobs['data'].data."""
+        import jax
+        import jax.numpy as jnp
+        for k, v in kwargs.items():
+            self._inputs[k] = np.asarray(v)
+        feeds = {}
+        for name in self._net.feed_blobs:
+            val = self._input_value(name)
+            shape = self._net.blob_shapes[name]
+            feeds[name] = jnp.asarray(
+                val, jnp.int32 if name == "label" else None).reshape(shape)
+        if self._fwd_jit is None:
+            self._fwd_jit = jax.jit(
+                lambda p, s, f: self._net.apply(p, s, f, train=False)[0])
+        env = self._fwd_jit(self._params, self._state, feeds)
+        self._blob_values = {k: np.array(v) for k, v in env.items()}
+        want = blobs or self.outputs
+        return {b: self._blob_values[b] for b in want
+                if b in self._blob_values}
+
+    def backward(self) -> None:
+        """Populate param diffs via jax.grad of the total loss."""
+        import jax
+        import jax.numpy as jnp
+        feeds = {}
+        for name in self._net.feed_blobs:
+            shape = self._net.blob_shapes[name]
+            feeds[name] = jnp.asarray(self._input_value(name)).reshape(shape)
+
+        def loss_fn(p):
+            _, _, loss = self._net.apply(p, self._state, feeds, train=True,
+                                         rng=jax.random.PRNGKey(0))
+            return loss
+
+        self._grads = jax.grad(loss_fn)(self._params)
+
+    def copy_from(self, weights_file: str) -> None:
+        self._params, self._state = self._net.import_weights(
+            self._params, self._state, _io.load_weights(weights_file))
+        self._fwd_jit = None
+
+    def save(self, path: str) -> None:
+        weights = self._net.export_weights(self._params, self._state)
+        types = {l.name: l.lp.type for l in self._net.layers}
+        if path.endswith((".h5", ".hdf5")):
+            _io.save_caffemodel_h5(path, weights)
+        else:
+            _io.save_caffemodel(path, weights, self._net.name, types)
+
+    def reshape(self) -> None:  # shapes are static under jit
+        pass
+
+
+class SGDSolver:
+    """caffe.SGDSolver(solver_file) — wraps the framework Solver; data comes
+    from the net's data layers or via solver.net.blobs[...] assignment."""
+
+    def __init__(self, solver_file: str):
+        from .solver import Solver as _Solver
+        import os
+        self._sp = SolverParameter.from_file(solver_file)
+        model_dir = ""
+        if self._sp.net and not os.path.exists(self._sp.net):
+            model_dir = os.path.dirname(os.path.abspath(solver_file))
+        self._solver = _Solver(self._sp, model_dir=model_dir)
+        from .tools.cli import _build_feeders
+        self._feeder = _build_feeders(self._solver.net, "TRAIN",
+                                      model_dir=model_dir)
+
+    @property
+    def net(self):
+        shim = Net.__new__(Net)
+        shim._net = self._solver.net
+        shim._params = self._solver.params
+        shim._state = self._solver.net_state
+        shim._blob_values = {}
+        shim._grads = None
+        shim._inputs = getattr(self, "_shim_inputs", {})
+        self._shim_inputs = shim._inputs
+        shim._fwd_jit = None
+        return shim
+
+    @property
+    def iter(self) -> int:
+        return self._solver.iter
+
+    def _feed_fn(self):
+        if self._feeder is not None:
+            return self._feeder
+        inputs = getattr(self, "_shim_inputs", {})
+
+        def fn(it):
+            import jax.numpy as jnp
+            feeds = {}
+            for name in self._solver.net.feed_blobs:
+                shape = self._solver.net.blob_shapes[name]
+                val = inputs.get(name)
+                if val is None:
+                    raise RuntimeError(
+                        f"no data for input blob {name!r}: assign "
+                        "solver.net.blobs[...].data first")
+                feeds[name] = jnp.asarray(val).reshape(shape)
+            return feeds
+        return fn
+
+    def step(self, n: int) -> None:
+        self._solver.step(n, self._feed_fn())
+
+    def solve(self) -> None:
+        self._solver.solve(self._feed_fn())
+
+    def snapshot(self) -> str:
+        return self._solver.snapshot()
+
+    def restore(self, path: str) -> None:
+        self._solver.restore(path)
+
+
+# solver-type aliases (reference exposes one class per registered solver)
+class NesterovSolver(SGDSolver):
+    pass
+
+
+class AdaGradSolver(SGDSolver):
+    pass
+
+
+class RMSPropSolver(SGDSolver):
+    pass
+
+
+class AdaDeltaSolver(SGDSolver):
+    pass
+
+
+class AdamSolver(SGDSolver):
+    pass
+
+
+def get_solver(solver_file: str) -> SGDSolver:
+    return SGDSolver(solver_file)

@@ -81,15 +81,16 @@ class Solver:
         self.update_fn = UPDATE_FNS[self.type]
         self.rank = rank
 
+        self.model_dir = model_dir
         train_param = _load_net_param(sp, "TRAIN", model_dir)
         self.net = Net(train_param, phase="TRAIN", batch_divisor=batch_divisor,
-                       data_shape_probe=data_shape_probe)
+                       data_shape_probe=data_shape_probe, model_dir=model_dir)
         self.test_nets: list[Net] = []
         n_tests = max(len(sp.test_net), len(sp.test_net_param),
                       1 if (sp.net or sp.net_param is not None) and sp.test_iter else 0)
         for i in range(n_tests):
             tp = _load_net_param(sp, "TEST", model_dir, i)
-            self.test_nets.append(Net(tp, phase="TEST",
+            self.test_nets.append(Net(tp, phase="TEST", model_dir=model_dir,
                                       data_shape_probe=data_shape_probe))
 
         seed = sp.random_seed if sp.random_seed >= 0 else 0

@@ -70,12 +70,18 @@ def _synthetic_feed(net, seed=0):
     return feeds
 
 
-def _build_feeders(net, phase, rank=0, world=1):
+def _build_feeders(net, phase, rank=0, world=1, model_dir=""):
     """Create a Feeder per DB-backed data layer, or None for Input nets."""
     from ..data import feeder_from_layer
+    from ..data.feeder import HDF5Feeder
+    model_dir = model_dir or getattr(net, "model_dir", "")
     for layer in net.layers:
         if layer.lp.type in ("Data", "ImageData"):
-            return feeder_from_layer(layer.lp, phase, rank=rank, world=world)
+            return feeder_from_layer(layer.lp, phase, rank=rank, world=world,
+                                     model_dir=model_dir)
+        if layer.lp.type == "HDF5Data":
+            return HDF5Feeder(layer.lp, rank=rank, world=world,
+                              model_dir=model_dir)
     return None
 
 
@@ -163,10 +169,12 @@ def cmd_test(args) -> int:
     from ..net import Net
     from ..proto import NetParameter
     from .. import io as caffe_io
+    import os
     if not args.model:
         log.error("test requires -model")
         return 1
-    net = Net(NetParameter.from_file(args.model), phase="TEST")
+    net = Net(NetParameter.from_file(args.model), phase="TEST",
+              model_dir=os.path.dirname(os.path.abspath(args.model)))
     params, state = net.init(jax.random.PRNGKey(0))
     if args.weights:
         params, state = net.import_weights(params, state,

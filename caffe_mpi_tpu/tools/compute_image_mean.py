@@ -1,0 +1,45 @@
+"""compute_image_mean — dataset mean as a BlobProto binaryproto.
+
+Reference: tools/compute_image_mean.cpp (iterates the DB, averages pixels,
+writes mean.binaryproto consumed by transform_param.mean_file).
+
+Usage:
+    python -m caffe_mpi_tpu.tools.compute_image_mean \
+        [-backend lmdb|datumfile] INPUT_DB OUTPUT_FILE
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="compute_image_mean")
+    p.add_argument("-backend", "--backend", default="lmdb",
+                   choices=["lmdb", "datumfile"])
+    p.add_argument("input_db")
+    p.add_argument("output_file", nargs="?", default="mean.binaryproto")
+    args = p.parse_args(argv)
+
+    from ..data.datasets import open_dataset
+    from ..io import save_blob_binaryproto
+
+    ds = open_dataset(args.backend, args.input_db)
+    total = None
+    n = len(ds)
+    for i in range(n):
+        img, _ = ds.get(i)
+        img = np.asarray(img, np.float64)
+        total = img if total is None else total + img
+    mean = (total / n).astype(np.float32)
+    save_blob_binaryproto(args.output_file, mean[None])  # 4D like reference
+    print(f"Wrote mean of {n} images to {args.output_file}; "
+          f"channel means: {mean.mean(axis=(1, 2))}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

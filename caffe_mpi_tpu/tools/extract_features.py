@@ -1,0 +1,67 @@
+"""extract_features — dump named blob activations over N batches.
+
+Reference: tools/extract_features.cpp (writes features to LMDB); here the
+output is an HDF5 file with one dataset per blob, which is what downstream
+python consumers actually want.
+
+Usage:
+    python -m caffe_mpi_tpu.tools.extract_features \
+        WEIGHTS_FILE MODEL_PROTOTXT BLOB_NAME1[,BLOB2...] OUTPUT_H5 NUM_BATCHES
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="extract_features")
+    p.add_argument("weights")
+    p.add_argument("model")
+    p.add_argument("blobs")
+    p.add_argument("output")
+    p.add_argument("num_batches", type=int, nargs="?", default=10)
+    args = p.parse_args(argv)
+
+    import h5py
+    import jax
+
+    from ..io import load_weights
+    from ..net import Net
+    from ..proto import NetParameter
+    from .cli import _build_feeders, _synthetic_feed
+
+    import os
+    net = Net(NetParameter.from_file(args.model), phase="TEST",
+              model_dir=os.path.dirname(os.path.abspath(args.model)))
+    params, state = net.init(jax.random.PRNGKey(0))
+    params, state = net.import_weights(params, state,
+                                       load_weights(args.weights))
+    blob_names = args.blobs.split(",")
+    for b in blob_names:
+        if b not in net.blob_shapes:
+            print(f"unknown blob {b!r}", file=sys.stderr)
+            return 1
+    feeder = _build_feeders(net, "TEST")
+    fwd = jax.jit(lambda p, s, f: net.apply(p, s, f, train=False)[0])
+    chunks: dict[str, list] = {b: [] for b in blob_names}
+    import jax.numpy as jnp
+    for it in range(args.num_batches):
+        feeds = feeder(it) if feeder else _synthetic_feed(net, seed=it)
+        feeds = {k: jnp.asarray(v) for k, v in feeds.items()}
+        env = fwd(params, state, feeds)
+        for b in blob_names:
+            chunks[b].append(np.asarray(env[b]))
+    with h5py.File(args.output, "w") as f:
+        for b in blob_names:
+            f.create_dataset(b, data=np.concatenate(chunks[b]))
+    print(f"Extracted {args.num_batches} batches of {blob_names} "
+          f"to {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,81 @@
+"""pycaffe-compat API tests (reference python/caffe/test/test_net.py,
+test_solver.py scope)."""
+
+import numpy as np
+import pytest
+
+import caffe_mpi_tpu.pycaffe as caffe
+
+
+@pytest.fixture
+def model(tmp_path):
+    p = tmp_path / "net.prototxt"
+    p.write_text("""
+    name: "pynet"
+    layer { name: "data" type: "Input" top: "data" top: "label"
+            input_param { shape { dim: 4 dim: 3 dim: 8 dim: 8 }
+                          shape { dim: 4 } } }
+    layer { name: "conv" type: "Convolution" bottom: "data" top: "c"
+            convolution_param { num_output: 2 kernel_size: 3
+              weight_filler { type: "xavier" } } }
+    layer { name: "ip" type: "InnerProduct" bottom: "c" top: "score"
+            inner_product_param { num_output: 5
+              weight_filler { type: "xavier" } } }
+    layer { name: "loss" type: "SoftmaxWithLoss" bottom: "score"
+            bottom: "label" top: "loss" }
+    """)
+    return str(p)
+
+
+class TestNet:
+    def test_forward_kwargs(self, model, rng):
+        net = caffe.Net(model, caffe.TEST)
+        assert net.inputs == ["data", "label"]
+        assert "loss" in net.outputs
+        out = net.forward(data=rng.randn(4, 3, 8, 8).astype(np.float32),
+                          label=rng.randint(0, 5, 4))
+        assert out["loss"].shape == ()
+        assert net.blobs["score"].data.shape == (4, 5)
+
+    def test_params_and_backward(self, model, rng):
+        net = caffe.Net(model, caffe.TRAIN)
+        w = net.params["conv"][0]
+        assert w.data.shape == (2, 3, 3, 3)
+        net.forward(data=rng.randn(4, 3, 8, 8).astype(np.float32),
+                    label=rng.randint(0, 5, 4))
+        net.backward()
+        g = net.params["conv"][0].diff
+        assert g.shape == (2, 3, 3, 3) and np.abs(g).sum() > 0
+
+    def test_save_copy_from(self, model, tmp_path, rng):
+        net = caffe.Net(model, caffe.TEST)
+        x = rng.randn(4, 3, 8, 8).astype(np.float32)
+        lab = rng.randint(0, 5, 4)
+        y1 = net.forward(data=x, label=lab)["loss"]
+        wpath = str(tmp_path / "w.caffemodel")
+        net.save(wpath)
+        net2 = caffe.Net(model, wpath, caffe.TEST)
+        y2 = net2.forward(data=x, label=lab)["loss"]
+        np.testing.assert_allclose(y1, y2, rtol=1e-6)
+
+    def test_layer_type_list(self):
+        types = caffe.layer_type_list()
+        for t in ("Convolution", "Pooling", "InnerProduct", "ReLU",
+                  "SoftmaxWithLoss", "BatchNorm", "LRN"):
+            assert t in types
+
+
+class TestSolver:
+    def test_step_with_memory_inputs(self, model, tmp_path, rng):
+        sp = tmp_path / "solver.prototxt"
+        sp.write_text(f'net: "{model}"\nbase_lr: 0.05 momentum: 0.9\n'
+                      'lr_policy: "fixed" max_iter: 20 type: "SGD"\n')
+        solver = caffe.SGDSolver(str(sp))
+        net = solver.net
+        net.blobs["data"].data = rng.randn(4, 3, 8, 8).astype(np.float32)
+        net.blobs["label"].data = rng.randint(0, 5, 4)
+        w0 = solver.net.params["conv"][0].data.copy()
+        solver.step(5)
+        assert solver.iter == 5
+        w1 = solver.net.params["conv"][0].data
+        assert not np.allclose(w0, w1)

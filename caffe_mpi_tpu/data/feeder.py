@@ -48,6 +48,16 @@ class Feeder:
         self.seed = seed
         self.lookahead = max(lookahead, 1)
         self.to_device = to_device
+        self.threads = max(threads, 1)
+        # native C++ transform path: engaged when built and the transform is
+        # expressible there (no force_color/gray); per-batch uniform-shape
+        # uint8 checked at run time, python path as fallback
+        self._native = False
+        if transformer is not None:
+            from .. import native
+            tp = transformer.tp
+            self._native = (native.available() and not tp.force_color
+                            and not tp.force_gray)
         self.pool = ThreadPoolExecutor(max_workers=max(threads, 1))
         self._futures: dict[int, Future] = {}
         self._lock = threading.Lock()
@@ -75,23 +85,39 @@ class Feeder:
         return int(perm[within])
 
     def _build_batch(self, it: int) -> dict[str, np.ndarray]:
-        imgs, labels = [], []
+        raws, labels, flats = [], [], []
         for slot in range(self.batch):
             rec = self._record_index(it, slot)
             img, label = self.ds.get(rec)
-            if self.tf is not None:
-                # per-record RNG: deterministic augmentation independent of
-                # thread scheduling (vs the reference's per-thread RNGs)
-                flat = it * self.batch * self.world + self.rank * self.batch + slot
-                img = self.tf(img, rng=self.tf.record_rng(flat))
-            else:
-                img = np.asarray(img, np.float32)
-            imgs.append(img)
+            raws.append(img)
             labels.append(label)
-        out = {self.top_names[0]: np.stack(imgs)}
+            flats.append(it * self.batch * self.world
+                         + self.rank * self.batch + slot)
+        batch = self._transform(raws, flats)
+        out = {self.top_names[0]: batch}
         if len(self.top_names) > 1:
             out[self.top_names[1]] = np.asarray(labels, np.int32)
         return out
+
+    def _transform(self, raws: list[np.ndarray], flats: list[int]) -> np.ndarray:
+        tf = self.tf
+        if tf is None:
+            return np.stack([np.asarray(r, np.float32) for r in raws])
+        if (self._native and raws[0].dtype == np.uint8
+                and all(r.shape == raws[0].shape for r in raws)):
+            from .. import native
+            mean = tf.mean
+            if mean is not None and mean.ndim == 3 and mean.shape[1] == 1:
+                mean = mean.reshape(-1)  # per-channel (c,1,1) -> (c,)
+            return native.transform_batch(
+                np.stack(raws), np.asarray(flats, np.int64),
+                crop=tf.tp.crop_size, mean=mean, scale=tf.tp.scale,
+                train=(tf.phase == "TRAIN"), mirror=tf.tp.mirror,
+                seed=tf.seed or 0, num_threads=self.threads)
+        # python reference path: per-record Philox RNG — deterministic
+        # augmentation independent of thread scheduling
+        return np.stack([tf(r, rng=tf.record_rng(f))
+                         for r, f in zip(raws, flats)])
 
     # ------------------------------------------------------------------
     def __call__(self, it: int) -> dict:

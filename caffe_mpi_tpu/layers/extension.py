@@ -1,0 +1,147 @@
+"""Extension layers: Python (user-defined), Filter, HDF5Output, Parameter.
+
+Reference: src/caffe/layers/python_layer.cpp + include/caffe/layers/
+python_layer.hpp (WITH_PYTHON_LAYER escape hatch), filter_layer.cpp,
+hdf5_output_layer.cpp, parameter_layer.hpp.
+
+The Python layer is the one place imperative user code meets the traced
+graph: the user's numpy `forward` runs through `jax.pure_callback` (host
+round-trip per call — the documented slow path, exactly as the reference's
+GIL-bound python layers are). If the user class defines `backward_jax` it is
+used as a custom VJP; otherwise the layer is treated as non-differentiable
+(stop_gradient), matching layers that set propagate_down false.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import Layer, Shape, register
+
+
+@register("Python")
+class PythonLayer(Layer):
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        p = self.lp.python_param
+        if p is None or not p.module or not p.layer:
+            raise ValueError(f"{self.name}: python_param.module/layer required")
+        mod = importlib.import_module(p.module)
+        cls = getattr(mod, p.layer)
+        self.impl = cls()
+        self.impl.param_str = p.param_str
+        # reference protocol: setup(bottom, top) mutates top shapes; here the
+        # user implements shape inference functionally
+        if not hasattr(self.impl, "infer_shapes"):
+            raise ValueError(
+                f"{self.name}: python layer {p.layer!r} must define "
+                "infer_shapes(bottom_shapes) -> top_shapes (the functional "
+                "equivalent of the reference's setup/reshape)")
+        if hasattr(self.impl, "setup"):
+            self.impl.setup(in_shapes)
+        out = [tuple(s) for s in self.impl.infer_shapes(in_shapes)]
+        self._out_struct = None
+        return out
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        impl = self.impl
+        out_struct = [
+            jax.ShapeDtypeStruct(s, self.policy.forward)
+            for s in self.out_shapes
+        ]
+
+        def host_forward(*arrays):
+            outs = impl.forward([np.asarray(a) for a in arrays])
+            return tuple(np.asarray(o, np.float32) for o in outs)
+
+        tops = jax.pure_callback(host_forward, tuple(
+            jax.ShapeDtypeStruct(s, jnp.float32) for s in self.out_shapes),
+            *bottoms)
+        tops = [t.astype(self.policy.forward) for t in tops]
+        if not hasattr(impl, "backward_jax"):
+            tops = [jax.lax.stop_gradient(t) for t in tops]
+        return list(tops), state
+
+
+@register("Filter")
+class FilterLayer(Layer):
+    """Select batch items where the last bottom (selector) is nonzero
+    (filter_layer.cpp). Data-dependent output size is incompatible with
+    XLA static shapes, so the TPU-native semantics keep the batch dimension
+    and zero out filtered items, with a mask top appended when an extra top
+    name is given."""
+
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        outs = [tuple(s) for s in in_shapes[:-1]]
+        if len(self.lp.top) == len(in_shapes):
+            outs.append((in_shapes[-1][0],))
+        return outs
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        selector = bottoms[-1].reshape(-1)
+        mask = (selector != 0)
+        tops = []
+        for x in bottoms[:-1]:
+            shape = [x.shape[0]] + [1] * (x.ndim - 1)
+            tops.append(x * mask.reshape(shape).astype(x.dtype))
+        if len(self.lp.top) == len(bottoms):
+            tops.append(mask.astype(jnp.float32))
+        return tops, state
+
+
+@register("HDF5Output")
+class HDF5OutputLayer(Layer):
+    """Writes its two bottoms to an HDF5 file (hdf5_output_layer.cpp).
+    Host I/O from a traced graph goes through io_callback; batches append
+    under incrementing keys."""
+
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        p = self.lp.hdf5_output_param
+        if p is None or not p.file_name:
+            raise ValueError(f"{self.name}: hdf5_output_param.file_name required")
+        self.file_name = p.file_name
+        self._batch_counter = 0
+        self._initialized = False
+        return []
+
+    def _write(self, *arrays):
+        import h5py
+        mode = "a" if self._initialized else "w"
+        with h5py.File(self.file_name, mode) as f:
+            g = f.create_group(f"batch_{self._batch_counter}")
+            for i, arr in enumerate(arrays):
+                name = "data" if i == 0 else "label" if i == 1 else f"blob{i}"
+                g.create_dataset(name, data=np.asarray(arr))
+        self._initialized = True
+        self._batch_counter += 1
+        return np.zeros((), np.float32)
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        from jax.experimental import io_callback
+        io_callback(self._write, jax.ShapeDtypeStruct((), jnp.float32),
+                    *bottoms, ordered=True)
+        return [], state
+
+
+@register("Parameter")
+class ParameterLayer(Layer):
+    """Exposes a learnable blob as a top (parameter_layer.hpp)."""
+
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        from ..proto.config import FillerParameter
+        node = getattr(self.lp, "_node", None)
+        shape = None
+        if node is not None and "parameter_param" in node:
+            pp = node.get("parameter_param")
+            if "shape" in pp:
+                shape = tuple(pp.get("shape").get_list("dim"))
+        if shape is None:
+            raise ValueError(f"{self.name}: parameter_param.shape required")
+        self.declare("weight", shape, FillerParameter(type="constant"))
+        return [shape]
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        return [self.f(params["weight"])], state

@@ -1,0 +1,87 @@
+"""ctypes binding for the native batch transformer.
+
+Loads libcaffe_tpu_native.so (built by build.sh / CMake) and exposes
+`transform_batch`. `available()` gates callers; the Python numpy path in
+data.transformer is the behavioral reference and fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+
+
+def _load():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    path = os.path.join(os.path.dirname(__file__), "libcaffe_tpu_native.so")
+    if not os.path.exists(path):
+        return None
+    lib = ctypes.CDLL(path)
+    if lib.caffe_tpu_native_abi_version() != 1:
+        return None
+    lib.caffe_tpu_transform_batch.restype = ctypes.c_int
+    lib.caffe_tpu_transform_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p),          # srcs
+        ctypes.POINTER(ctypes.c_int64),           # record_ids
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,  # n c h w
+        ctypes.c_int,                             # crop
+        ctypes.c_void_p,                          # mean
+        ctypes.c_int, ctypes.c_float,             # mean_mode, scale
+        ctypes.c_int, ctypes.c_int,               # train, mirror
+        ctypes.c_uint64,                          # seed
+        ctypes.POINTER(ctypes.c_float),           # out
+        ctypes.c_int,                             # num_threads
+    ]
+    _LIB = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def transform_batch(images: np.ndarray, record_ids: np.ndarray, *,
+                    crop: int = 0, mean: np.ndarray | None = None,
+                    scale: float = 1.0, train: bool = True,
+                    mirror: bool = False, seed: int = 0,
+                    num_threads: int = 4) -> np.ndarray:
+    """images: (N,C,H,W) uint8 contiguous. Returns (N,C,oh,ow) float32."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library not built; run native/build.sh")
+    images = np.ascontiguousarray(images, np.uint8)
+    n, c, h, w = images.shape
+    oh = ow = crop if crop else 0
+    if not crop:
+        oh, ow = h, w
+    out = np.empty((n, c, oh, ow), np.float32)
+    src_ptrs = (ctypes.c_void_p * n)(*[
+        images.ctypes.data + i * c * h * w for i in range(n)])
+    rec = np.ascontiguousarray(record_ids, np.int64)
+    mean_mode = 0
+    mean_ptr = None
+    if mean is not None:
+        mean = np.ascontiguousarray(mean, np.float32)
+        if mean.ndim == 1 or mean.size == c:
+            mean_mode = 1
+        else:
+            if mean.shape[-2:] != (h, w):
+                raise ValueError("full mean must match image size")
+            mean_mode = 2
+        mean_ptr = mean.ctypes.data_as(ctypes.c_void_p)
+    rc = lib.caffe_tpu_transform_batch(
+        src_ptrs, rec.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n, c, h, w, crop, mean_ptr, mean_mode, scale,
+        int(train), int(mirror), seed,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), num_threads)
+    if rc != 0:
+        raise RuntimeError(f"native transform failed with code {rc}")
+    return out

@@ -1,0 +1,142 @@
+// Native batch transformer — the hot host-side loop of the data pipeline.
+//
+// Role in the framework: the reference implements its DataTransformer and
+// batch assembly in C++/CUDA (src/caffe/data_transformer.cpp, 753 LoC, plus
+// transformer threads in base_data_layer.cpp). On TPU the device-side
+// transform is unnecessary (XLA fuses the scale/mean arithmetic if desired),
+// but the HOST side — decode -> crop -> mirror -> mean/scale -> float32
+// batch — must keep up with the chips. This library does that work in
+// multithreaded C++, called from the Python Feeder via ctypes (GIL released
+// during the call).
+//
+// Crop/mirror randomness is counter-based (splitmix64 keyed on
+// seed ^ record_index) so augmentation is deterministic per record
+// regardless of thread scheduling — the same property the Python path gets
+// from Philox streams (values differ between the two paths; determinism
+// within a path is the contract, as in the reference's per-thread RNGs).
+//
+// Semantics mirror data_transformer.cpp Transform(): TEST phase -> center
+// crop, no mirror; TRAIN -> uniform random crop offset + 50% mirror;
+// out = (pixel - mean) * scale; mean is per-channel or full-image (subtracted
+// at the same crop window).
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ull;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+  return x ^ (x >> 31);
+}
+
+struct TransformArgs {
+  const uint8_t* const* srcs;  // n pointers to CHW uint8 images
+  const int64_t* record_ids;   // n global record indices (RNG keys)
+  int n, c, h, w;              // input geometry
+  int crop;                    // 0 = no crop; output is crop x crop otherwise
+  const float* mean;           // nullptr | c floats | c*h*w floats
+  int mean_mode;               // 0 none, 1 per-channel, 2 full image
+  float scale;
+  int train;                   // 1 = random crop + mirror; 0 = center crop
+  int mirror;                  // mirror enabled (train only)
+  uint64_t seed;
+  float* out;                  // n x c x oh x ow
+};
+
+void transform_range(const TransformArgs& a, int begin, int end) {
+  const int oh = a.crop ? a.crop : a.h;
+  const int ow = a.crop ? a.crop : a.w;
+  const int64_t in_plane = (int64_t)a.h * a.w;
+  const int64_t out_plane = (int64_t)oh * ow;
+  for (int i = begin; i < end; ++i) {
+    const uint8_t* src = a.srcs[i];
+    float* dst = a.out + (int64_t)i * a.c * out_plane;
+    int off_h = 0, off_w = 0, do_mirror = 0;
+    if (a.crop) {
+      if (a.train) {
+        uint64_t r = splitmix64(a.seed ^ (uint64_t)a.record_ids[i]);
+        off_h = (int)(r % (uint64_t)(a.h - a.crop + 1));
+        r = splitmix64(r);
+        off_w = (int)(r % (uint64_t)(a.w - a.crop + 1));
+        if (a.mirror) {
+          r = splitmix64(r);
+          do_mirror = (int)(r & 1);
+        }
+      } else {
+        off_h = (a.h - a.crop) / 2;
+        off_w = (a.w - a.crop) / 2;
+      }
+    } else if (a.train && a.mirror) {
+      uint64_t r = splitmix64(a.seed ^ (uint64_t)a.record_ids[i]);
+      do_mirror = (int)(r & 1);
+    }
+    for (int ch = 0; ch < a.c; ++ch) {
+      const uint8_t* splane = src + ch * in_plane;
+      const float* mplane =
+          a.mean_mode == 2 ? a.mean + ch * in_plane : nullptr;
+      const float mch = a.mean_mode == 1 ? a.mean[ch] : 0.f;
+      float* dplane = dst + ch * out_plane;
+      for (int y = 0; y < oh; ++y) {
+        const uint8_t* srow = splane + (int64_t)(y + off_h) * a.w + off_w;
+        const float* mrow =
+            mplane ? mplane + (int64_t)(y + off_h) * a.w + off_w : nullptr;
+        float* drow = dplane + (int64_t)y * ow;
+        if (do_mirror) {
+          for (int x = 0; x < ow; ++x) {
+            const float m = mrow ? mrow[x] : mch;
+            drow[ow - 1 - x] = ((float)srow[x] - m) * a.scale;
+          }
+        } else {
+          for (int x = 0; x < ow; ++x) {
+            const float m = mrow ? mrow[x] : mch;
+            drow[x] = ((float)srow[x] - m) * a.scale;
+          }
+        }
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success.
+int caffe_tpu_transform_batch(const uint8_t* const* srcs,
+                              const int64_t* record_ids, int n, int c, int h,
+                              int w, int crop, const float* mean,
+                              int mean_mode, float scale, int train,
+                              int mirror, uint64_t seed, float* out,
+                              int num_threads) {
+  if (n <= 0 || c <= 0 || h <= 0 || w <= 0) return 1;
+  if (crop < 0 || crop > h || crop > w) return 2;
+  if (mean_mode != 0 && mean == nullptr) return 3;
+  TransformArgs a{srcs, record_ids, n,     c,      h,    w,    crop,
+                  mean, mean_mode,  scale, train,  mirror, seed, out};
+  if (num_threads <= 1 || n == 1) {
+    transform_range(a, 0, n);
+    return 0;
+  }
+  int nt = num_threads < n ? num_threads : n;
+  std::vector<std::thread> threads;
+  threads.reserve(nt);
+  int chunk = (n + nt - 1) / nt;
+  for (int t = 0; t < nt; ++t) {
+    int begin = t * chunk;
+    int end = begin + chunk < n ? begin + chunk : n;
+    if (begin >= end) break;
+    threads.emplace_back([&a, begin, end] { transform_range(a, begin, end); });
+  }
+  for (auto& th : threads) th.join();
+  return 0;
+}
+
+int caffe_tpu_native_abi_version() { return 1; }
+
+}  // extern "C"

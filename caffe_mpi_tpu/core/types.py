@@ -42,18 +42,28 @@ def dtype_for(type_name: str, default: jnp.dtype = jnp.float32):
 @dataclass(frozen=True)
 class DtypePolicy:
     """Per-layer precision choice, resolved from layer + net defaults the way
-    reference net.cpp:100-156 resolves forward_type/backward_type."""
+    reference net.cpp:100-156 resolves forward_type/backward_type and
+    forward_math/backward_math."""
 
     forward: jnp.dtype = jnp.float32   # activation compute dtype
     backward: jnp.dtype = jnp.float32  # gradient compute dtype
     master: jnp.dtype = jnp.float32    # parameter storage dtype
+    # MXU math mode for matmul/conv: "default" lets XLA pick (bf16 multiplies
+    # with f32 accumulation — the analogue of the reference's tensor-op math
+    # override, cudnn_conv_layer.hpp cudnn_math_override); "highest" forces
+    # full-f32 multiplies (FLOAT/DOUBLE *_math request).
+    precision: str = "default"
 
     @classmethod
     def resolve(cls, layer_fwd: str, layer_bwd: str, net_fwd: str, net_bwd: str,
-                solver_storage: str = "FLOAT") -> "DtypePolicy":
+                solver_storage: str = "FLOAT", layer_math: str = "",
+                net_math: str = "") -> "DtypePolicy":
         fwd = dtype_for(layer_fwd or net_fwd)
         bwd = dtype_for(layer_bwd or net_bwd)
-        return cls(forward=fwd, backward=bwd, master=dtype_for(solver_storage))
+        math = (layer_math or net_math).upper()
+        precision = "highest" if math in ("FLOAT", "DOUBLE") else "default"
+        return cls(forward=fwd, backward=bwd,
+                   master=dtype_for(solver_storage), precision=precision)
 
     def cast_in(self, x):
         """Cast an input/param to the forward compute dtype (no-op for ints)."""

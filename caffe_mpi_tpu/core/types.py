@@ -54,14 +54,24 @@ class DtypePolicy:
     # full-f32 multiplies (FLOAT/DOUBLE *_math request).
     precision: str = "default"
 
+    @property
+    def lax_precision(self):
+        """Value for lax/jnp `precision=` arguments (None = XLA default)."""
+        return None if self.precision == "default" else self.precision
+
     @classmethod
     def resolve(cls, layer_fwd: str, layer_bwd: str, net_fwd: str, net_bwd: str,
                 solver_storage: str = "FLOAT", layer_math: str = "",
-                net_math: str = "") -> "DtypePolicy":
+                net_math: str = "", layer_bmath: str = "",
+                net_bmath: str = "") -> "DtypePolicy":
         fwd = dtype_for(layer_fwd or net_fwd)
         bwd = dtype_for(layer_bwd or net_bwd)
-        math = (layer_math or net_math).upper()
-        precision = "highest" if math in ("FLOAT", "DOUBLE") else "default"
+        # XLA derives backward precision from the forward op, so the op runs
+        # at the stricter of the forward/backward math requests
+        fmath = (layer_math or net_math).upper()
+        bmath = (layer_bmath or net_bmath).upper()
+        strict = {"FLOAT", "DOUBLE"}
+        precision = "highest" if (fmath in strict or bmath in strict) else "default"
         return cls(forward=fwd, backward=bwd,
                    master=dtype_for(solver_storage), precision=precision)
 

@@ -36,8 +36,8 @@ class InnerProductLayer(Layer):
         lead = x.shape[: self.axis]
         x2 = x.reshape(math.prod(lead) if lead else 1, self.k)
         w = self.f(params["weight"])
-        prec = None if self.policy.precision == "default" else self.policy.precision
-        y = jnp.matmul(x2, w if self.p.transpose else w.T, precision=prec)
+        y = jnp.matmul(x2, w if self.p.transpose else w.T,
+                       precision=self.policy.lax_precision)
         if self.p.bias_term:
             y = y + self.f(params["bias"])
         return [y.reshape(*lead, self.p.num_output)], state

@@ -68,7 +68,7 @@ class ConvolutionLayer(Layer):
         x = self.f(bottoms[0])
         w = self.f(params["weight"])
         y = conv2d(x, w, self.stride, self.pad, self.dilation, self.p.group,
-                   precision=self.policy.precision)
+                   precision=self.policy.lax_precision)
         if self.p.bias_term:
             y = y + self.f(params["bias"])[None, :, None, None]
         return [y], state
@@ -98,7 +98,8 @@ class DeconvolutionLayer(Layer):
     def apply(self, params, state, bottoms, *, train, rng):
         x = self.f(bottoms[0])
         w = self.f(params["weight"])
-        y = deconv2d(x, w, self.stride, self.pad, self.dilation, self.p.group)
+        y = deconv2d(x, w, self.stride, self.pad, self.dilation, self.p.group,
+                     precision=self.policy.lax_precision)
         if self.p.bias_term:
             y = y + self.f(params["bias"])[None, :, None, None]
         return [y], state

@@ -77,6 +77,7 @@ class Net:
                 param.default_forward_type, param.default_backward_type,
                 solver_storage,
                 lp.forward_math, param.default_forward_math,
+                lp.backward_math, param.default_backward_math,
             )
             if lp.type in ("Data", "ImageData") and batch_divisor > 1:
                 self._divide_batch(lp, batch_divisor)

@@ -40,13 +40,13 @@ def conv2d(x: jnp.ndarray, w: jnp.ndarray, stride: tuple[int, int],
         rhs_dilation=dilation,
         dimension_numbers=dn,
         feature_group_count=groups,
-        precision=None if precision in (None, "default") else precision,
+        precision=precision,
     )
 
 
 def deconv2d(x: jnp.ndarray, w: jnp.ndarray, stride: tuple[int, int],
              pad: tuple[int, int], dilation: tuple[int, int] = (1, 1),
-             groups: int = 1) -> jnp.ndarray:
+             groups: int = 1, precision: str | None = None) -> jnp.ndarray:
     """Transposed conv (reference deconv_layer.cpp: backward-of-conv as
     forward). x: (N, Cin, H, W); w: (Cin, Cout/groups, kh, kw) — Caffe keeps
     the conv weight layout with the roles of the feature dims swapped.
@@ -61,7 +61,8 @@ def deconv2d(x: jnp.ndarray, w: jnp.ndarray, stride: tuple[int, int],
         xs = jnp.split(x, groups, axis=1)
         ws = jnp.split(w, groups, axis=0)
         return jnp.concatenate(
-            [deconv2d(xi, wi, stride, pad, dilation, 1) for xi, wi in zip(xs, ws)],
+            [deconv2d(xi, wi, stride, pad, dilation, 1, precision)
+             for xi, wi in zip(xs, ws)],
             axis=1,
         )
     # conv_transpose with flipped kernel reproduces gradient-of-conv exactly
@@ -75,6 +76,7 @@ def deconv2d(x: jnp.ndarray, w: jnp.ndarray, stride: tuple[int, int],
         lhs_dilation=stride,
         rhs_dilation=dilation,
         dimension_numbers=dn,
+        precision=precision,
     )
 
 

@@ -1,0 +1,160 @@
+"""pycaffe io module — preprocessing + array/proto conversions.
+
+Reference: python/caffe/io.py (383 LoC): Transformer (preprocess/deprocess
+with transpose/channel_swap/raw_scale/mean/input_scale), load_image,
+resize_image, oversample, array_to_datum/datum_to_array,
+blobproto_to_array/array_to_blobproto.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .data.datasets import encode_datum, parse_datum
+from .io import encode_blob, parse_blob
+
+
+# -- proto conversions ------------------------------------------------------
+
+def blobproto_to_array(buf: bytes) -> np.ndarray:
+    return parse_blob(buf)
+
+
+def array_to_blobproto(arr: np.ndarray) -> bytes:
+    return encode_blob(np.asarray(arr, np.float32))
+
+
+def array_to_datum(arr: np.ndarray, label: int = 0) -> bytes:
+    return encode_datum(np.asarray(arr, np.uint8), label)
+
+
+def datum_to_array(buf: bytes) -> tuple[np.ndarray, int]:
+    return parse_datum(buf)
+
+
+# -- images -----------------------------------------------------------------
+
+def load_image(filename: str, color: bool = True) -> np.ndarray:
+    """Load as float [0,1] HWC RGB (reference io.py load_image semantics)."""
+    from PIL import Image
+    img = Image.open(filename)
+    img = img.convert("RGB" if color else "L")
+    arr = np.asarray(img, np.float32) / 255.0
+    if not color:
+        arr = arr[:, :, None]
+    return arr
+
+
+def resize_image(im: np.ndarray, new_dims, interp_order: int = 1) -> np.ndarray:
+    """Resize HWC float image (PIL bilinear for order=1, nearest for 0)."""
+    from PIL import Image
+    h, w = int(new_dims[0]), int(new_dims[1])
+    mode = Image.BILINEAR if interp_order else Image.NEAREST
+    chans = []
+    for c in range(im.shape[2]):
+        chan = Image.fromarray(im[:, :, c].astype(np.float32), mode="F")
+        chans.append(np.asarray(chan.resize((w, h), mode)))
+    return np.stack(chans, axis=2)
+
+
+def oversample(images, crop_dims) -> np.ndarray:
+    """10-crop augmentation: 4 corners + center, mirrored
+    (reference io.py oversample)."""
+    im_shape = np.array(images[0].shape[:2])
+    crop_dims = np.array(crop_dims)
+    im_center = im_shape / 2.0
+    h_indices = (0, im_shape[0] - crop_dims[0])
+    w_indices = (0, im_shape[1] - crop_dims[1])
+    crops_ix = np.empty((5, 4), dtype=int)
+    curr = 0
+    for i in h_indices:
+        for j in w_indices:
+            crops_ix[curr] = (i, j, i + crop_dims[0], j + crop_dims[1])
+            curr += 1
+    crops_ix[4] = np.tile(im_center, (1, 2)) + np.concatenate(
+        [-crop_dims / 2.0, crop_dims / 2.0])
+    crops_ix = np.tile(crops_ix, (2, 1))
+    crops = np.empty((10 * len(images), crop_dims[0], crop_dims[1],
+                      images[0].shape[-1]), dtype=np.float32)
+    ix = 0
+    for im in images:
+        for crop in crops_ix:
+            crops[ix] = im[crop[0]:crop[2], crop[1]:crop[3], :]
+            ix += 1
+        crops[ix - 5:ix] = crops[ix - 5:ix, :, ::-1, :]  # mirror last 5
+    return crops
+
+
+class Transformer:
+    """Input preprocessing (reference io.py Transformer): per-input
+    transpose, channel_swap, raw_scale, mean, input_scale."""
+
+    def __init__(self, inputs: dict[str, tuple]):
+        self.inputs = inputs
+        self.transpose: dict[str, tuple] = {}
+        self.channel_swap: dict[str, tuple] = {}
+        self.raw_scale: dict[str, float] = {}
+        self.mean: dict[str, np.ndarray] = {}
+        self.input_scale: dict[str, float] = {}
+
+    def _check(self, in_: str) -> None:
+        if in_ not in self.inputs:
+            raise ValueError(f"{in_} is not one of the net inputs "
+                             f"{list(self.inputs)}")
+
+    def set_transpose(self, in_: str, order) -> None:
+        self._check(in_)
+        self.transpose[in_] = tuple(order)
+
+    def set_channel_swap(self, in_: str, order) -> None:
+        self._check(in_)
+        self.channel_swap[in_] = tuple(order)
+
+    def set_raw_scale(self, in_: str, scale: float) -> None:
+        self._check(in_)
+        self.raw_scale[in_] = scale
+
+    def set_mean(self, in_: str, mean: np.ndarray) -> None:
+        self._check(in_)
+        mean = np.asarray(mean, np.float32)
+        if mean.ndim == 1:
+            mean = mean[:, None, None]
+        self.mean[in_] = mean
+
+    def set_input_scale(self, in_: str, scale: float) -> None:
+        self._check(in_)
+        self.input_scale[in_] = scale
+
+    def preprocess(self, in_: str, data: np.ndarray) -> np.ndarray:
+        self._check(in_)
+        out = np.asarray(data, np.float32)
+        in_dims = self.inputs[in_][2:]
+        if out.shape[:2] != tuple(in_dims):
+            out = resize_image(out, in_dims)
+        if in_ in self.transpose:
+            out = out.transpose(self.transpose[in_])
+        if in_ in self.channel_swap:
+            out = out[np.array(self.channel_swap[in_]), :, :]
+        if in_ in self.raw_scale:
+            out = out * self.raw_scale[in_]
+        if in_ in self.mean:
+            out = out - self.mean[in_]
+        if in_ in self.input_scale:
+            out = out * self.input_scale[in_]
+        return out
+
+    def deprocess(self, in_: str, data: np.ndarray) -> np.ndarray:
+        self._check(in_)
+        out = np.asarray(data, np.float32).squeeze()
+        if in_ in self.input_scale:
+            out = out / self.input_scale[in_]
+        if in_ in self.mean:
+            out = out + self.mean[in_]
+        if in_ in self.raw_scale:
+            out = out / self.raw_scale[in_]
+        if in_ in self.channel_swap:
+            inv = np.argsort(self.channel_swap[in_])
+            out = out[inv, :, :]
+        if in_ in self.transpose:
+            out = out.transpose(np.argsort(self.transpose[in_]))
+        return out

@@ -1,0 +1,122 @@
+"""Classifier / Detector — batch inference wrappers (pycaffe parity).
+
+Reference: python/caffe/classifier.py (center-crop or oversampled
+classification) and python/caffe/detector.py (R-CNN style window
+detection). Both sit on the pycaffe Net + Transformer.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import caffe_io
+from .pycaffe import Net
+
+
+class Classifier(Net):
+    def __init__(self, model_file: str, pretrained_file: str,
+                 image_dims=None, mean=None, input_scale=None,
+                 raw_scale=None, channel_swap=None):
+        super().__init__(model_file, pretrained_file, "TEST")
+        in_ = self.inputs[0]
+        shape = self._net.blob_shapes[in_]
+        self.transformer = caffe_io.Transformer({in_: shape})
+        self.transformer.set_transpose(in_, (2, 0, 1))
+        if mean is not None:
+            self.transformer.set_mean(in_, mean)
+        if input_scale is not None:
+            self.transformer.set_input_scale(in_, input_scale)
+        if raw_scale is not None:
+            self.transformer.set_raw_scale(in_, raw_scale)
+        if channel_swap is not None:
+            self.transformer.set_channel_swap(in_, channel_swap)
+        self.crop_dims = np.array(shape[2:])
+        self.image_dims = np.array(image_dims) if image_dims is not None \
+            else self.crop_dims
+
+    def predict(self, inputs, oversample: bool = True) -> np.ndarray:
+        in_ = self.inputs[0]
+        resized = [caffe_io.resize_image(im, self.image_dims)
+                   for im in inputs]
+        if oversample:
+            crops = caffe_io.oversample(resized, self.crop_dims)
+        else:
+            center = np.array([(self.image_dims[0] - self.crop_dims[0]) // 2,
+                               (self.image_dims[1] - self.crop_dims[1]) // 2])
+            crops = np.stack([
+                im[center[0]:center[0] + self.crop_dims[0],
+                   center[1]:center[1] + self.crop_dims[1], :]
+                for im in resized])
+        batch_size = self._net.blob_shapes[in_][0]
+        preds = []
+        for start in range(0, len(crops), batch_size):
+            chunk = crops[start:start + batch_size]
+            data = np.stack([self.transformer.preprocess(in_, c)
+                             for c in chunk])
+            if len(data) < batch_size:  # pad the static batch
+                pad = np.zeros((batch_size - len(data), *data.shape[1:]),
+                               np.float32)
+                data = np.concatenate([data, pad])
+            out = self.forward(**{in_: data})
+            prob_blob = self.outputs[-1]
+            preds.append(out[prob_blob][:len(chunk)])
+        preds = np.concatenate(preds)
+        if oversample:
+            preds = preds.reshape(len(inputs), 10, -1).mean(axis=1)
+        return preds
+
+
+class Detector(Net):
+    """Window detector: classify image crops (reference detector.py)."""
+
+    def __init__(self, model_file: str, pretrained_file: str, mean=None,
+                 input_scale=None, raw_scale=None, channel_swap=None,
+                 context_pad: int = 0):
+        super().__init__(model_file, pretrained_file, "TEST")
+        in_ = self.inputs[0]
+        shape = self._net.blob_shapes[in_]
+        self.transformer = caffe_io.Transformer({in_: shape})
+        self.transformer.set_transpose(in_, (2, 0, 1))
+        if mean is not None:
+            self.transformer.set_mean(in_, mean)
+        if input_scale is not None:
+            self.transformer.set_input_scale(in_, input_scale)
+        if raw_scale is not None:
+            self.transformer.set_raw_scale(in_, raw_scale)
+        if channel_swap is not None:
+            self.transformer.set_channel_swap(in_, channel_swap)
+        self.context_pad = context_pad
+
+    def detect_windows(self, images_windows) -> list[dict]:
+        in_ = self.inputs[0]
+        crop_dims = self._net.blob_shapes[in_][2:]
+        batch_size = self._net.blob_shapes[in_][0]
+        window_inputs = []
+        meta = []
+        for image_fname, windows in images_windows:
+            image = caffe_io.load_image(image_fname)
+            for window in windows:
+                y0, x0, y1, x1 = [int(v) for v in window]
+                crop = image[max(y0, 0):y1, max(x0, 0):x1, :]
+                window_inputs.append(
+                    caffe_io.resize_image(crop, crop_dims))
+                meta.append((image_fname, window))
+        detections = []
+        for start in range(0, len(window_inputs), batch_size):
+            chunk = window_inputs[start:start + batch_size]
+            data = np.stack([self.transformer.preprocess(in_, c)
+                             for c in chunk])
+            if len(data) < batch_size:
+                pad = np.zeros((batch_size - len(data), *data.shape[1:]),
+                               np.float32)
+                data = np.concatenate([data, pad])
+            out = self.forward(**{in_: data})
+            scores = out[self.outputs[-1]][:len(chunk)]
+            for (fname, window), score in zip(meta[start:start + batch_size],
+                                              scores):
+                detections.append({
+                    "window": window,
+                    "prediction": score,
+                    "filename": fname,
+                })
+        return detections

@@ -200,20 +200,26 @@ class InfogainLossLayer(LossBase):
     H comes from bottom[2] or from a file (not yet supported)."""
 
     def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        self.H_file = None
         if len(in_shapes) < 3:
             p = self.lp.infogain_loss_param
             if not (p and p.source):
                 raise ValueError(f"{self.name}: infogain needs H as third "
                                  "bottom or a source file")
-            raise NotImplementedError(
-                f"{self.name}: loading H from binaryproto file not yet supported"
-            )
+            from ..io import load_blob_binaryproto
+            k = in_shapes[0][1]
+            self.H_file = jnp.asarray(
+                load_blob_binaryproto(p.source).reshape(k, k), jnp.float32)
         return [()]
 
     def apply(self, params, state, bottoms, *, train, rng):
         prob = self.f(bottoms[0]).astype(jnp.float32)
         labels = bottoms[1].astype(jnp.int32).reshape(-1)
-        H = self.f(bottoms[2]).astype(jnp.float32).reshape(prob.shape[1], prob.shape[1])
+        if self.H_file is not None:
+            H = self.H_file
+        else:
+            H = self.f(bottoms[2]).astype(jnp.float32).reshape(
+                prob.shape[1], prob.shape[1])
         n = prob.shape[0]
         rows = H[labels]  # (n, K)
         loss = -jnp.sum(rows * jnp.log(jnp.maximum(prob.reshape(n, -1), 1e-20))) / n

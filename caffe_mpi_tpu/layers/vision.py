@@ -126,20 +126,47 @@ class PoolingLayer(Layer):
         oh = pool_output_dim(h, self.kernel[0], self.pad[0], self.stride[0])
         ow = pool_output_dim(w, self.kernel[1], self.pad[1], self.stride[1])
         self.method = str(p.pool).upper()
-        if self.method == "STOCHASTIC":
-            raise NotImplementedError(
-                "STOCHASTIC pooling is not implemented yet (reference "
-                "pooling_layer.cpp:239); use MAX or AVE"
-            )
+        if self.method == "STOCHASTIC" and (self.pad[0] or self.pad[1]):
+            raise ValueError("STOCHASTIC pooling does not support padding "
+                             "(reference pooling_layer.cpp CHECKs the same)")
         return [(n, c, oh, ow)]
 
     def apply(self, params, state, bottoms, *, train, rng):
         x = self.f(bottoms[0])
         if self.method == "AVE":
             y = avg_pool2d(x, self.kernel, self.stride, self.pad)
+        elif self.method == "STOCHASTIC":
+            y = self._stochastic(x, train, rng)
         else:
             y = max_pool2d(x, self.kernel, self.stride, self.pad)
         return [y], state
+
+    def _stochastic(self, x, train, rng):
+        """Stochastic pooling (pooling_layer.cpp:239-300): TRAIN samples a
+        window element with probability proportional to its (non-negative)
+        activation; TEST returns the activation-weighted average
+        sum(a^2)/sum(a)."""
+        from ..ops.conv import DN
+        n, c, h, w = x.shape
+        kh, kw = self.kernel
+        patches = lax.conv_general_dilated_patches(
+            x, filter_shape=(kh, kw), window_strides=self.stride,
+            padding=((0, 0), (0, 0)),
+            dimension_numbers=DN(x.shape, (1, 1, kh, kw),
+                                 ("NCHW", "OIHW", "NCHW")))
+        oh, ow = patches.shape[2], patches.shape[3]
+        pat = patches.reshape(n, c, kh * kw, oh, ow)
+        total = jnp.sum(pat, axis=2)
+        if train:
+            if rng is None:
+                raise ValueError(f"{self.name}: stochastic pooling needs rng")
+            r = jax.random.uniform(rng, (n, c, oh, ow)) * total
+            cum = jnp.cumsum(pat, axis=2)
+            idx = jnp.argmax(cum >= r[:, :, None], axis=2)
+            y = jnp.take_along_axis(pat, idx[:, :, None], axis=2)[:, :, 0]
+            return jnp.where(total > 0, y, 0.0)
+        sq = jnp.sum(pat * pat, axis=2)
+        return jnp.where(total > 0, sq / jnp.maximum(total, 1e-12), 0.0)
 
 
 @register("LRN")

@@ -312,3 +312,8 @@ class AdamSolver(SGDSolver):
 
 def get_solver(solver_file: str) -> SGDSolver:
     return SGDSolver(solver_file)
+
+
+# io / Classifier / Detector (imported last: classifier subclasses Net above)
+from . import caffe_io as io  # noqa: E402,F401
+from .classifier import Classifier, Detector  # noqa: E402,F401

@@ -1,0 +1,68 @@
+"""Image-classification web demo (reference examples/web_demo/app.py).
+
+Flask app serving a single endpoint that classifies an uploaded image with
+a pycaffe Classifier. Flask is not part of the baked image; the app errors
+with instructions if it is missing.
+
+    python examples/web_demo/app.py -model deploy.prototxt -weights w.caffemodel
+"""
+
+import argparse
+import io as _io
+import sys
+
+import numpy as np
+
+
+def make_app(model: str, weights: str, labels_file: str | None = None):
+    try:
+        import flask
+    except ImportError:
+        raise SystemExit(
+            "The web demo requires flask, which is not installed in this "
+            "environment (pip install flask)."
+        )
+    import caffe_mpi_tpu.pycaffe as caffe
+
+    clf = caffe.Classifier(model, weights)
+    labels = None
+    if labels_file:
+        with open(labels_file) as f:
+            labels = [l.strip() for l in f]
+
+    app = flask.Flask(__name__)
+
+    @app.route("/classify", methods=["POST"])
+    def classify():
+        from PIL import Image
+        file = flask.request.files["image"]
+        img = np.asarray(Image.open(_io.BytesIO(file.read())).convert("RGB"),
+                         np.float32) / 255.0
+        preds = clf.predict([img], oversample=False)[0]
+        top = np.argsort(-preds)[:5]
+        return flask.jsonify({
+            "predictions": [
+                {"label": labels[i] if labels else int(i),
+                 "score": float(preds[i])} for i in top
+            ]
+        })
+
+    @app.route("/")
+    def index():
+        return ("<form method=post action=/classify "
+                "enctype=multipart/form-data>"
+                "<input type=file name=image>"
+                "<input type=submit value=Classify></form>")
+
+    return app
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("-model", required=True)
+    p.add_argument("-weights", required=True)
+    p.add_argument("-labels", default=None)
+    p.add_argument("-port", type=int, default=5000)
+    args = p.parse_args()
+    make_app(args.model, args.weights, args.labels).run(
+        host="127.0.0.1", port=args.port)

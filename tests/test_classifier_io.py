@@ -1,0 +1,114 @@
+"""Tests for pycaffe io (Transformer/oversample/conversions), Classifier,
+stochastic pooling, and InfogainLoss-from-file."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import caffe_mpi_tpu.pycaffe as caffe
+from caffe_mpi_tpu import caffe_io
+from gradcheck import make_layer
+
+
+class TestIO:
+    def test_datum_conversions(self):
+        arr = np.arange(12, dtype=np.uint8).reshape(3, 2, 2)
+        buf = caffe_io.array_to_datum(arr, 5)
+        back, label = caffe_io.datum_to_array(buf)
+        np.testing.assert_array_equal(back, arr)
+        assert label == 5
+
+    def test_transformer_roundtrip(self, rng):
+        t = caffe_io.Transformer({"data": (1, 3, 8, 8)})
+        t.set_transpose("data", (2, 0, 1))
+        t.set_channel_swap("data", (2, 1, 0))
+        t.set_raw_scale("data", 255.0)
+        t.set_mean("data", np.array([10.0, 20.0, 30.0]))
+        img = rng.rand(8, 8, 3).astype(np.float32)
+        pre = t.preprocess("data", img)
+        assert pre.shape == (3, 8, 8)
+        back = t.deprocess("data", pre)  # returns HWC (inverse of preprocess)
+        np.testing.assert_allclose(back, img, atol=1e-4)
+
+    def test_oversample(self, rng):
+        imgs = [rng.rand(10, 10, 3).astype(np.float32)]
+        crops = caffe_io.oversample(imgs, (8, 8))
+        assert crops.shape == (10, 8, 8, 3)
+        # mirrored second half
+        np.testing.assert_allclose(crops[5], crops[0][:, ::-1, :])
+
+
+class TestClassifier:
+    def test_predict(self, tmp_path, rng):
+        model = tmp_path / "deploy.prototxt"
+        model.write_text("""
+        name: "toy"
+        layer { name: "data" type: "Input" top: "data"
+                input_param { shape { dim: 4 dim: 3 dim: 8 dim: 8 } } }
+        layer { name: "ip" type: "InnerProduct" bottom: "data" top: "score"
+                inner_product_param { num_output: 5
+                  weight_filler { type: "xavier" } } }
+        layer { name: "prob" type: "Softmax" bottom: "score" top: "prob" }
+        """)
+        net = caffe.Net(str(model), caffe.TEST)
+        w = str(tmp_path / "w.caffemodel")
+        net.save(w)
+        clf = caffe.Classifier(str(model), w, image_dims=(10, 10))
+        imgs = [rng.rand(12, 12, 3).astype(np.float32) for _ in range(2)]
+        preds = clf.predict(imgs, oversample=True)
+        assert preds.shape == (2, 5)
+        np.testing.assert_allclose(preds.sum(axis=1), 1.0, rtol=1e-4)
+        preds2 = clf.predict(imgs, oversample=False)
+        assert preds2.shape == (2, 5)
+
+
+class TestStochasticPooling:
+    def test_train_samples_within_window(self, rng):
+        layer, params, state = make_layer(
+            'name: "p" type: "Pooling" bottom: "x" top: "y"\n'
+            'pooling_param { pool: STOCHASTIC kernel_size: 2 stride: 2 }',
+            [(2, 3, 4, 4)],
+        )
+        x = jnp.abs(jnp.asarray(rng.randn(2, 3, 4, 4).astype(np.float32)))
+        (y,), _ = layer.apply(params, state, [x], train=True,
+                              rng=jax.random.PRNGKey(0))
+        assert y.shape == (2, 3, 2, 2)
+        # each output must be one of its window's elements
+        xn, yn = np.array(x), np.array(y)
+        for n in range(2):
+            for c in range(3):
+                for i in range(2):
+                    for j in range(2):
+                        win = xn[n, c, 2*i:2*i+2, 2*j:2*j+2].reshape(-1)
+                        assert np.any(np.isclose(win, yn[n, c, i, j]))
+
+    def test_test_weighted_average(self, rng):
+        layer, params, state = make_layer(
+            'name: "p" type: "Pooling" bottom: "x" top: "y"\n'
+            'pooling_param { pool: STOCHASTIC kernel_size: 2 stride: 2 }',
+            [(1, 1, 2, 2)],
+        )
+        x = jnp.asarray([[[[1.0, 2.0], [3.0, 4.0]]]])
+        (y,), _ = layer.apply(params, state, [x], train=False, rng=None)
+        expect = (1 + 4 + 9 + 16) / (1 + 2 + 3 + 4)
+        np.testing.assert_allclose(float(y[0, 0, 0, 0]), expect, rtol=1e-5)
+
+
+class TestInfogainFromFile:
+    def test_identity_H_matches_multinomial(self, tmp_path, rng):
+        from caffe_mpi_tpu.io import save_blob_binaryproto
+        H = np.eye(4, dtype=np.float32)
+        hp = str(tmp_path / "H.binaryproto")
+        save_blob_binaryproto(hp, H)
+        layer, params, state = make_layer(
+            f'name: "l" type: "InfogainLoss" bottom: "p" bottom: "t" top: "loss"\n'
+            f'infogain_loss_param {{ source: "{hp}" }}',
+            [(3, 4), (3,)],
+        )
+        prob = jax.nn.softmax(jnp.asarray(rng.randn(3, 4).astype(np.float32)))
+        t = jnp.asarray(rng.randint(0, 4, 3))
+        (loss,), _ = layer.apply(params, state, [prob, t], train=True, rng=None)
+        picked = np.array(prob)[np.arange(3), np.array(t)]
+        expect = -np.log(picked).sum() / 3
+        np.testing.assert_allclose(float(loss), expect, rtol=1e-5)

@@ -2,7 +2,7 @@
 
 Reference: python/caffe/classifier.py (center-crop or oversampled
 classification) and python/caffe/detector.py (R-CNN style window
-detection). Both sit on the pycaffe Net + Transformer.
+detection with context padding). Both sit on the pycaffe Net + Transformer.
 """
 
 from __future__ import annotations
@@ -13,10 +13,11 @@ from . import caffe_io
 from .pycaffe import Net
 
 
-class Classifier(Net):
-    def __init__(self, model_file: str, pretrained_file: str,
-                 image_dims=None, mean=None, input_scale=None,
-                 raw_scale=None, channel_swap=None):
+class _PreprocessingNet(Net):
+    """Shared transformer setup + padded static-batch forward loop."""
+
+    def __init__(self, model_file: str, pretrained_file: str, mean=None,
+                 input_scale=None, raw_scale=None, channel_swap=None):
         super().__init__(model_file, pretrained_file, "TEST")
         in_ = self.inputs[0]
         shape = self._net.blob_shapes[in_]
@@ -30,12 +31,38 @@ class Classifier(Net):
             self.transformer.set_raw_scale(in_, raw_scale)
         if channel_swap is not None:
             self.transformer.set_channel_swap(in_, channel_swap)
-        self.crop_dims = np.array(shape[2:])
+
+    def _forward_batched(self, crops) -> np.ndarray:
+        """Preprocess + forward a list of HWC crops through the net's static
+        batch, padding the tail chunk; returns scores from the last output."""
+        in_ = self.inputs[0]
+        batch_size = self._net.blob_shapes[in_][0]
+        out_blob = self.outputs[-1]
+        preds = []
+        for start in range(0, len(crops), batch_size):
+            chunk = crops[start:start + batch_size]
+            data = np.stack([self.transformer.preprocess(in_, c)
+                             for c in chunk])
+            if len(data) < batch_size:
+                pad = np.zeros((batch_size - len(data), *data.shape[1:]),
+                               np.float32)
+                data = np.concatenate([data, pad])
+            out = self.forward(**{in_: data})
+            preds.append(out[out_blob][:len(chunk)])
+        return np.concatenate(preds)
+
+
+class Classifier(_PreprocessingNet):
+    def __init__(self, model_file: str, pretrained_file: str,
+                 image_dims=None, mean=None, input_scale=None,
+                 raw_scale=None, channel_swap=None):
+        super().__init__(model_file, pretrained_file, mean, input_scale,
+                         raw_scale, channel_swap)
+        self.crop_dims = np.array(self._net.blob_shapes[self.inputs[0]][2:])
         self.image_dims = np.array(image_dims) if image_dims is not None \
             else self.crop_dims
 
     def predict(self, inputs, oversample: bool = True) -> np.ndarray:
-        in_ = self.inputs[0]
         resized = [caffe_io.resize_image(im, self.image_dims)
                    for im in inputs]
         if oversample:
@@ -47,76 +74,54 @@ class Classifier(Net):
                 im[center[0]:center[0] + self.crop_dims[0],
                    center[1]:center[1] + self.crop_dims[1], :]
                 for im in resized])
-        batch_size = self._net.blob_shapes[in_][0]
-        preds = []
-        for start in range(0, len(crops), batch_size):
-            chunk = crops[start:start + batch_size]
-            data = np.stack([self.transformer.preprocess(in_, c)
-                             for c in chunk])
-            if len(data) < batch_size:  # pad the static batch
-                pad = np.zeros((batch_size - len(data), *data.shape[1:]),
-                               np.float32)
-                data = np.concatenate([data, pad])
-            out = self.forward(**{in_: data})
-            prob_blob = self.outputs[-1]
-            preds.append(out[prob_blob][:len(chunk)])
-        preds = np.concatenate(preds)
+        preds = self._forward_batched(list(crops))
         if oversample:
             preds = preds.reshape(len(inputs), 10, -1).mean(axis=1)
         return preds
 
 
-class Detector(Net):
+class Detector(_PreprocessingNet):
     """Window detector: classify image crops (reference detector.py)."""
 
     def __init__(self, model_file: str, pretrained_file: str, mean=None,
                  input_scale=None, raw_scale=None, channel_swap=None,
                  context_pad: int = 0):
-        super().__init__(model_file, pretrained_file, "TEST")
-        in_ = self.inputs[0]
-        shape = self._net.blob_shapes[in_]
-        self.transformer = caffe_io.Transformer({in_: shape})
-        self.transformer.set_transpose(in_, (2, 0, 1))
-        if mean is not None:
-            self.transformer.set_mean(in_, mean)
-        if input_scale is not None:
-            self.transformer.set_input_scale(in_, input_scale)
-        if raw_scale is not None:
-            self.transformer.set_raw_scale(in_, raw_scale)
-        if channel_swap is not None:
-            self.transformer.set_channel_swap(in_, channel_swap)
+        super().__init__(model_file, pretrained_file, mean, input_scale,
+                         raw_scale, channel_swap)
         self.context_pad = context_pad
 
+    def _expand_window(self, window, im_shape, crop_dims):
+        """Apply context padding in window coordinates (reference
+        detector.py detect_windows context_pad path / window_data_layer
+        context_scale)."""
+        y0, x0, y1, x1 = [float(v) for v in window]
+        if self.context_pad:
+            crop_h = float(crop_dims[0])
+            scale = crop_h / (crop_h - 2.0 * self.context_pad)
+            half_h = (y1 - y0 + 1) / 2.0
+            half_w = (x1 - x0 + 1) / 2.0
+            cy, cx = y0 + half_h, x0 + half_w
+            y0, y1 = cy - half_h * scale, cy + half_h * scale
+            x0, x1 = cx - half_w * scale, cx + half_w * scale
+        y0, x0 = max(int(y0), 0), max(int(x0), 0)
+        y1 = min(int(y1), im_shape[0])
+        x1 = min(int(x1), im_shape[1])
+        return y0, x0, y1, x1
+
     def detect_windows(self, images_windows) -> list[dict]:
-        in_ = self.inputs[0]
-        crop_dims = self._net.blob_shapes[in_][2:]
-        batch_size = self._net.blob_shapes[in_][0]
+        crop_dims = self._net.blob_shapes[self.inputs[0]][2:]
         window_inputs = []
         meta = []
         for image_fname, windows in images_windows:
             image = caffe_io.load_image(image_fname)
             for window in windows:
-                y0, x0, y1, x1 = [int(v) for v in window]
-                crop = image[max(y0, 0):y1, max(x0, 0):x1, :]
-                window_inputs.append(
-                    caffe_io.resize_image(crop, crop_dims))
+                y0, x0, y1, x1 = self._expand_window(window, image.shape,
+                                                     crop_dims)
+                crop = image[y0:y1, x0:x1, :]
+                window_inputs.append(caffe_io.resize_image(crop, crop_dims))
                 meta.append((image_fname, window))
-        detections = []
-        for start in range(0, len(window_inputs), batch_size):
-            chunk = window_inputs[start:start + batch_size]
-            data = np.stack([self.transformer.preprocess(in_, c)
-                             for c in chunk])
-            if len(data) < batch_size:
-                pad = np.zeros((batch_size - len(data), *data.shape[1:]),
-                               np.float32)
-                data = np.concatenate([data, pad])
-            out = self.forward(**{in_: data})
-            scores = out[self.outputs[-1]][:len(chunk)]
-            for (fname, window), score in zip(meta[start:start + batch_size],
-                                              scores):
-                detections.append({
-                    "window": window,
-                    "prediction": score,
-                    "filename": fname,
-                })
-        return detections
+        scores = self._forward_batched(window_inputs)
+        return [
+            {"window": window, "prediction": score, "filename": fname}
+            for (fname, window), score in zip(meta, scores)
+        ]

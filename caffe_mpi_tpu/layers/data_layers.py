@@ -136,6 +136,23 @@ class ImageDataLayer(PipelineDataLayer):
         return self._data_shapes(p.batch_size, c, h, w)
 
 
+@register("WindowData")
+class WindowDataLayer(InputLayerBase):
+    """R-CNN window sampling (window_data_layer.cpp); batches produced by
+    data.window.WindowFeeder."""
+
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        p = self.lp.window_data_param
+        crop = p.crop_size or (self.lp.transform_param.crop_size
+                               if self.lp.transform_param else 0)
+        if not crop:
+            raise ValueError(f"{self.name}: WindowData requires crop_size")
+        shapes = [(p.batch_size, 3, crop, crop)]
+        if len(self.lp.top) > 1:
+            shapes.append((p.batch_size,))
+        return shapes
+
+
 @register("HDF5Data")
 class HDF5DataLayer(InputLayerBase):
     bound_shapes: list[tuple] | None = None

@@ -206,10 +206,12 @@ class InfogainLossLayer(LossBase):
             if not (p and p.source):
                 raise ValueError(f"{self.name}: infogain needs H as third "
                                  "bottom or a source file")
+            import os
             from ..io import load_blob_binaryproto
             k = in_shapes[0][1]
+            src = os.path.join(getattr(self, "model_dir", ""), p.source)
             self.H_file = jnp.asarray(
-                load_blob_binaryproto(p.source).reshape(k, k), jnp.float32)
+                load_blob_binaryproto(src).reshape(k, k), jnp.float32)
         return [()]
 
     def apply(self, params, state, bottoms, *, train, rng):

@@ -147,11 +147,19 @@ class PoolingLayer(Layer):
         activation; TEST returns the activation-weighted average
         sum(a^2)/sum(a)."""
         from ..ops.conv import DN
+        from ..ops.pool import _pad_amounts, pool_output_dim
         n, c, h, w = x.shape
         kh, kw = self.kernel
+        # ceil-mode output dims like MAX/AVE: zero-pad the high side; zeros
+        # carry zero sampling weight, reproducing the reference's window
+        # truncation at the boundary
+        oh = pool_output_dim(h, kh, 0, self.stride[0])
+        ow = pool_output_dim(w, kw, 0, self.stride[1])
+        ph = _pad_amounts(h, kh, 0, self.stride[0], oh)
+        pw = _pad_amounts(w, kw, 0, self.stride[1], ow)
         patches = lax.conv_general_dilated_patches(
             x, filter_shape=(kh, kw), window_strides=self.stride,
-            padding=((0, 0), (0, 0)),
+            padding=(ph, pw),
             dimension_numbers=DN(x.shape, (1, 1, kh, kw),
                                  ("NCHW", "OIHW", "NCHW")))
         oh, ow = patches.shape[2], patches.shape[3]

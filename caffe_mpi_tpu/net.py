@@ -82,6 +82,7 @@ class Net:
             if lp.type in ("Data", "ImageData") and batch_divisor > 1:
                 self._divide_batch(lp, batch_divisor)
             layer = create_layer(lp, policy, phase)
+            layer.model_dir = model_dir  # base for any layer-level file paths
             if lp.type in ("Data", "HDF5Data"):
                 probe = data_shape_probe
                 if probe is None:

@@ -1,0 +1,122 @@
+"""Attention + ring attention (sequence/context parallelism).
+
+The reference is a CNN-era framework with no attention op (SURVEY §5.7),
+but this framework treats long-context and distributed execution as
+first-class: the mesh carries a sequence-parallel story from day one.
+
+- `attention`: standard multi-head scaled-dot-product attention on one
+  device, (B, S, H, D) layout, optional causal mask. XLA maps the two
+  batched matmuls straight onto the MXU.
+- `ring_attention`: the same computation with the SEQUENCE axis sharded
+  over a mesh axis. Each device owns one Q/K/V shard; K/V shards rotate
+  around the ring with `lax.ppermute` while a numerically-stable online
+  softmax (flash-attention style running max/sum) accumulates partial
+  results — sequence length scales with the number of devices at O(S/n)
+  memory per device, and the ppermute traffic rides the ICI ring.
+
+Layout note: (batch, seq, heads, head_dim); collectives run under
+`shard_map` with the seq axis mapped to a mesh axis.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_attn(q, k, v, *, scale, mask=None):
+    """One q-block x k-block attention with running-softmax stats.
+
+    q: (B,Sq,H,D), k/v: (B,Sk,H,D). Returns (out_unnorm, row_max, row_sum)
+    where out_unnorm = sum_j exp(s_ij - row_max) v_j."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -jnp.inf)
+    m = jnp.max(s, axis=-1)                      # (B,H,Sq)
+    # guard fully-masked rows (exp(-inf - -inf)); contribute zeros
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    p = jnp.exp(s - m_safe[..., None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1)                      # (B,H,Sq)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return out, m_safe, l
+
+
+def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+              causal: bool = False) -> jnp.ndarray:
+    """Single-device reference: q,k,v (B,S,H,D) -> (B,S,H,D)."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    mask = None
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool))[None, None]
+    out, m, l = _block_attn(q, k, v, scale=scale, mask=mask)
+    return out / jnp.maximum(l, 1e-30)[..., None].swapaxes(1, 2)
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                   axis_name: str, causal: bool = False) -> jnp.ndarray:
+    """Sequence-parallel attention inside shard_map.
+
+    q,k,v: the LOCAL sequence shard (B, S/n, H, D) on each device of the
+    `axis_name` mesh axis. Returns the local output shard. K/V blocks make
+    one full trip around the ring (n-1 ppermutes), overlapping compute with
+    neighbor transfers — the TPU-native equivalent of all-gather-free
+    context parallelism."""
+    n_dev = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    block_len = q.shape[1]
+    b, s, h, d = q.shape
+
+    def step(carry, i):
+        out, m, l, kk, vv = carry
+        src_idx = (my_idx + i) % n_dev
+        mask = None
+        if causal:
+            a = jnp.arange(block_len)[:, None]
+            bcol = jnp.arange(block_len)[None, :]
+            mask = ((my_idx * block_len + a) >= (src_idx * block_len + bcol))
+            mask = mask[None, None]
+        blk_out, blk_m, blk_l = _block_attn(q, kk, vv, scale=scale, mask=mask)
+        # online-softmax merge of (out, m, l) with the new block
+        new_m = jnp.maximum(m, blk_m)
+        alpha = jnp.exp(m - new_m)      # rescale old accumulation
+        beta = jnp.exp(blk_m - new_m)   # rescale new block
+        l_new = l * alpha + blk_l * beta
+        out_new = (out * alpha[..., None].swapaxes(1, 2)
+                   + blk_out * beta[..., None].swapaxes(1, 2))
+        # rotate K/V to the next device (ring over the mesh axis)
+        perm = [(j, (j - 1) % n_dev) for j in range(n_dev)]
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        return (out_new, new_m, l_new, kk, vv), None
+
+    out0 = jnp.zeros_like(q)
+    # mark the softmax stats as varying over the ring axis so the scan carry
+    # types line up under shard_map's per-device type tracking
+    m0 = lax.pvary(jnp.full((b, h, s), -jnp.inf, q.dtype), (axis_name,))
+    l0 = lax.pvary(jnp.zeros((b, h, s), q.dtype), (axis_name,))
+    (out, m, l, _, _), _ = lax.scan(step, (out0, m0, l0, k, v),
+                                    jnp.arange(n_dev))
+    return out / jnp.maximum(l, 1e-30)[..., None].swapaxes(1, 2)
+
+
+def sequence_parallel_attention(q, k, v, mesh, *, seq_axis: str = "model",
+                                causal: bool = False):
+    """Top-level entry: q,k,v (B,S,H,D) global arrays; shards S over
+    `seq_axis` and runs ring attention under shard_map."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    spec = P(None, seq_axis, None, None)
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name=seq_axis, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )
+    return fn(q, k, v)

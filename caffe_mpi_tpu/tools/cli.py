@@ -82,6 +82,10 @@ def _build_feeders(net, phase, rank=0, world=1, model_dir=""):
         if layer.lp.type == "HDF5Data":
             return HDF5Feeder(layer.lp, rank=rank, world=world,
                               model_dir=model_dir)
+        if layer.lp.type == "WindowData":
+            from ..data.window import WindowFeeder
+            return WindowFeeder(layer.lp, phase, model_dir=model_dir,
+                                rank=rank, world=world)
     return None
 
 

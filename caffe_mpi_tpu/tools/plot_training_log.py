@@ -33,11 +33,18 @@ def main(argv=None) -> int:
         if train:
             axes[0].plot([r["NumIters"] for r in train],
                          [r["loss"] for r in train], label=label)
-        acc_rows = [(r["NumIters"], v) for r in test
-                    for k, v in r.items() if k not in ("NumIters", "TestNet")]
-        if acc_rows:
-            axes[1].plot([a for a, _ in acc_rows], [v for _, v in acc_rows],
-                         label=label)
+        # one series per (test net, metric) — mixing metrics on one line
+        # would zigzag between incomparable scales
+        series: dict[tuple, list] = {}
+        for r in test:
+            for k, v in r.items():
+                if k in ("NumIters", "TestNet"):
+                    continue
+                series.setdefault((r.get("TestNet", 0), k), []).append(
+                    (r["NumIters"], v))
+        for (net_i, metric), rows in sorted(series.items()):
+            axes[1].plot([a for a, _ in rows], [v for _, v in rows],
+                         label=f"{label}:#{net_i}:{metric}")
     axes[0].set_xlabel("iteration")
     axes[0].set_ylabel("train loss")
     axes[0].legend(fontsize=7)

@@ -83,6 +83,21 @@ class TestStochasticPooling:
                         win = xn[n, c, 2*i:2*i+2, 2*j:2*j+2].reshape(-1)
                         assert np.any(np.isclose(win, yn[n, c, i, j]))
 
+    def test_ceil_mode_shape(self, rng):
+        # 5x5, k=2, s=2: Caffe ceil mode -> 3x3 (declared == produced)
+        layer, params, state = make_layer(
+            'name: "p" type: "Pooling" bottom: "x" top: "y"\n'
+            'pooling_param { pool: STOCHASTIC kernel_size: 2 stride: 2 }',
+            [(1, 1, 5, 5)],
+        )
+        assert layer.out_shapes == [(1, 1, 3, 3)]
+        x = jnp.abs(jnp.asarray(rng.randn(1, 1, 5, 5).astype(np.float32)))
+        (y,), _ = layer.apply(params, state, [x], train=True,
+                              rng=jax.random.PRNGKey(0))
+        assert y.shape == (1, 1, 3, 3)
+        (yt,), _ = layer.apply(params, state, [x], train=False, rng=None)
+        assert yt.shape == (1, 1, 3, 3)
+
     def test_test_weighted_average(self, rng):
         layer, params, state = make_layer(
             'name: "p" type: "Pooling" bottom: "x" top: "y"\n'

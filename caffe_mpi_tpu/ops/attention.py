@@ -48,8 +48,15 @@ def _block_attn(q, k, v, *, scale, mask=None):
 
 
 def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
-              causal: bool = False) -> jnp.ndarray:
-    """Single-device reference: q,k,v (B,S,H,D) -> (B,S,H,D)."""
+              causal: bool = False, use_flash: bool = False) -> jnp.ndarray:
+    """Single-device attention: q,k,v (B,S,H,D) -> (B,S,H,D).
+
+    use_flash: route through the Pallas flash-attention kernel
+    (ops/flash_attention.py) — O(S) memory VMEM-tiled online softmax;
+    forward-only, sequence lengths must tile evenly."""
+    if use_flash:
+        from .flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal)
     scale = 1.0 / math.sqrt(q.shape[-1])
     mask = None
     if causal:

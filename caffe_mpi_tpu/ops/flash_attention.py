@@ -1,0 +1,105 @@
+"""Pallas flash-attention forward kernel (TPU).
+
+The Pallas path of the framework: where XLA's fusion isn't enough, ops drop
+to hand-written TPU kernels (the reference's analogue is its hand-written
+CUDA kernels next to cuDNN ops). Attention is the canonical case — naive
+attention materializes the (Sq, Sk) score matrix in HBM; this kernel keeps
+it in VMEM tiles with an online softmax, O(S) memory instead of O(S^2).
+
+Layout: (B, H, S, D) inside the kernel (sequence-minor tiles). The public
+entry accepts the framework's (B, S, H, D) and transposes at the edges.
+Grid: (B*H, Sq/BQ); the innermost K loop runs as a fori_loop over Sk/BK
+tiles within the kernel, accumulating (out, m, l) in VMEM scratch.
+
+Used by ops.attention.attention when `use_flash=True` on TPU; the jnp
+implementation remains the reference and the CPU/interpret fallback.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+BQ = 128  # query tile (MXU-aligned)
+BK = 128  # key tile
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, sk, bq, bk):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # (bq, d)
+    n_k = sk // bk
+
+    def body(j, carry):
+        out, m, l = carry
+        k = k_ref[0, pl.dslice(j * bk, bk), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(j * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows >= cols, s, -jnp.inf)
+        blk_m = jnp.max(s, axis=1)
+        blk_m = jnp.where(jnp.isneginf(blk_m), 0.0, blk_m)
+        p = jnp.exp(s - blk_m[:, None])
+        if causal:
+            p = jnp.where(rows >= cols, p, 0.0)
+        blk_l = jnp.sum(p, axis=1)
+        new_m = jnp.maximum(m, blk_m)
+        alpha = jnp.exp(m - new_m)
+        beta = jnp.exp(blk_m - new_m)
+        l = l * alpha + blk_l * beta
+        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        out = out * alpha[:, None] + pv * beta[:, None]
+        return out, new_m, l
+
+    d = q_ref.shape[-1]
+    out0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    if causal:
+        # only K tiles at or before this Q tile can contribute
+        n_iter = jnp.minimum((qi + 1) * bq + bk - 1, sk) // bk
+    else:
+        n_iter = n_k
+    out, m, l = jax.lax.fori_loop(0, n_iter, body, (out0, m0, l0))
+    o_ref[0] = (out / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                    causal: bool = False, interpret: bool = False
+                    ) -> jnp.ndarray:
+    """q,k,v: (B, S, H, D) -> (B, S, H, D). Forward only (inference path);
+    training uses the jnp reference whose VJP XLA handles."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    bq = min(BQ, sq)
+    bk = min(BK, sk)
+    if sq % bq or sk % bk:
+        raise ValueError(f"sequence lengths ({sq},{sk}) must be multiples "
+                         f"of the tile sizes ({bq},{bk})")
+    scale = 1.0 / math.sqrt(d)
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                               sk=sk, bq=bq, bk=bk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

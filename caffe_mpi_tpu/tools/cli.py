@@ -41,6 +41,8 @@ def _parser() -> argparse.ArgumentParser:
         ("phase", dict(default="TEST", choices=["TRAIN", "TEST"])),
         ("synthetic", dict(action="store_true",
                            help="feed random data into Input layers")),
+        ("profile", dict(default="", help="write a JAX/XLA profiler trace "
+                                          "(xplane) to this directory")),
     ]:
         p.add_argument(f"-{flag}", f"--{flag}", **kw)
     return p
@@ -260,8 +262,16 @@ def cmd_time(args) -> int:
         jax.block_until_ready(out)
         return (time.perf_counter() - t0) / iters * 1e3
 
-    fwd_ms = whole(False)
-    total_ms = whole(True) if net.loss_blobs else float("nan")
+    if args.profile:
+        # TPU tracing parity (reference relies on `caffe time`+nvprof; here
+        # the xplane trace opens in TensorBoard/XProf)
+        with jax.profiler.trace(args.profile):
+            fwd_ms = whole(False)
+            total_ms = whole(True) if net.loss_blobs else float("nan")
+        print(f"profiler trace written to {args.profile}")
+    else:
+        fwd_ms = whole(False)
+        total_ms = whole(True) if net.loss_blobs else float("nan")
     print(f"{'layer':<28}{'type':<20}{'fwd ms (isolated)':>18}")
     for name, tname, ms in rows:
         print(f"{name:<28}{tname:<20}{ms:>18.3f}")

@@ -40,6 +40,24 @@ class TestAttention:
         np.testing.assert_allclose(np.array(out)[:, 0], expect0, rtol=1e-5)
 
 
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, rng, causal):
+        from caffe_mpi_tpu.ops.flash_attention import flash_attention
+        q, k, v = qkv(rng, b=2, s=256, h=2, d=32)
+        ref = attention(q, k, v, causal=causal)
+        # interpret mode on CPU; the same kernel compiles via Mosaic on TPU
+        out = flash_attention(q, k, v, causal=causal, interpret=True)
+        np.testing.assert_allclose(np.array(out), np.array(ref), rtol=2e-5,
+                                   atol=1e-6)
+
+    def test_rejects_ragged_sequences(self, rng):
+        from caffe_mpi_tpu.ops.flash_attention import flash_attention
+        q, k, v = qkv(rng, s=130)
+        with pytest.raises(ValueError, match="multiples"):
+            flash_attention(q, k, v)
+
+
 class TestRingAttention:
     @pytest.mark.parametrize("causal", [False, True])
     def test_matches_single_device(self, rng, causal):

@@ -225,6 +225,15 @@ def cmd_time(args) -> int:
     blobs = dict(blobs)
     rows = []
     iters = max(args.iterations, 1)
+    def timeit(fn, *args):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters * 1e3
+
     for layer in net.layers:
         from ..layers.data_layers import InputLayerBase
         if isinstance(layer, InputLayerBase):
@@ -234,14 +243,24 @@ def cmd_time(args) -> int:
         lstate = state.get(layer.name, {})
         fn = jax.jit(lambda p, s, bs, layer=layer: layer.apply(
             p, s, bs, train=False, rng=None)[0])
-        out = fn(lparams, lstate, bottoms)
-        jax.block_until_ready(out)
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn(lparams, lstate, bottoms)
-        jax.block_until_ready(out)
-        ms = (time.perf_counter() - t0) / iters * 1e3
-        rows.append((layer.name, layer.lp.type, ms))
+        fwd_ms_l = timeit(fn, lparams, lstate, bottoms)
+        # isolated backward: VJP wrt params+float bottoms (reference times
+        # each layer's Backward the same way, tools/caffe.cpp:403-423)
+        float_idx = [i for i, b in enumerate(bottoms)
+                     if jnp.issubdtype(b.dtype, jnp.floating)]
+        bwd_ms_l = float("nan")
+        if lparams or float_idx:
+            def scalar_fn(p, bs, layer=layer, lstate=lstate):
+                tops, _ = layer.apply(p, lstate, bs, train=False, rng=None)
+                return sum(jnp.sum(t.astype(jnp.float32) ** 2) for t in tops
+                           if hasattr(t, "ndim"))
+            bwd = jax.jit(jax.grad(scalar_fn, argnums=(0, 1),
+                                   allow_int=True))
+            try:
+                bwd_ms_l = timeit(bwd, lparams, bottoms)
+            except Exception:
+                pass  # non-differentiable layer: report nan
+        rows.append((layer.name, layer.lp.type, fwd_ms_l, bwd_ms_l))
 
     def whole(train):
         rng_key = jax.random.PRNGKey(0)
@@ -272,9 +291,10 @@ def cmd_time(args) -> int:
     else:
         fwd_ms = whole(False)
         total_ms = whole(True) if net.loss_blobs else float("nan")
-    print(f"{'layer':<28}{'type':<20}{'fwd ms (isolated)':>18}")
-    for name, tname, ms in rows:
-        print(f"{name:<28}{tname:<20}{ms:>18.3f}")
+    print(f"{'layer':<28}{'type':<20}{'fwd ms':>12}{'bwd ms':>12}  (isolated)")
+    for name, tname, fms, bms in rows:
+        bs = f"{bms:.3f}" if bms == bms else "-"
+        print(f"{name:<28}{tname:<20}{fms:>12.3f}{bs:>12}")
     print(f"\nwhole-graph forward (fused): {fwd_ms:.3f} ms")
     print(f"whole-graph forward+backward (fused): {total_ms:.3f} ms")
     print(f"sum of isolated per-layer fwd: {sum(r[2] for r in rows):.3f} ms "

@@ -1,0 +1,49 @@
+"""Timers — device-accurate timing (reference util/benchmark.hpp Timer /
+CPUTimer, which use CUDA events for GPU-accurate spans).
+
+On TPU, accurate device timing means synchronizing on the arrays a span
+produced: `Timer.stop(wait_on=...)` calls block_until_ready before reading
+the clock, the JAX analogue of cudaEventSynchronize.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class Timer:
+    def __init__(self):
+        self._start = None
+        self._elapsed = 0.0
+        self.running = False
+
+    def start(self) -> None:
+        self._start = time.perf_counter()
+        self.running = True
+
+    def stop(self, wait_on=None) -> float:
+        """wait_on: array/pytree to block_until_ready before stopping —
+        without it a span around dispatched-but-unfinished device work
+        measures only dispatch latency."""
+        if wait_on is not None:
+            import jax
+            jax.block_until_ready(wait_on)
+        if self.running:
+            self._elapsed += time.perf_counter() - self._start
+            self.running = False
+        return self._elapsed
+
+    def seconds(self) -> float:
+        if self.running:
+            return self._elapsed + (time.perf_counter() - self._start)
+        return self._elapsed
+
+    def milliseconds(self) -> float:
+        return self.seconds() * 1e3
+
+    def reset(self) -> None:
+        self._elapsed = 0.0
+        self.running = False
+
+
+CPUTimer = Timer  # host-side spans need no device sync; same interface

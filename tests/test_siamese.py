@@ -1,0 +1,69 @@
+"""Siamese-network integration test (reference examples/siamese):
+cross-layer weight sharing by param name + ContrastiveLoss training —
+similar pairs pulled together, dissimilar pushed apart."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from caffe_mpi_tpu.net import Net
+from caffe_mpi_tpu.proto import NetParameter, SolverParameter
+from caffe_mpi_tpu.solver import Solver
+from caffe_mpi_tpu.coord_map import coord_map_from_to
+
+
+class TestSiamese:
+    def test_towers_share_weights(self):
+        net = Net(NetParameter.from_file("examples/siamese/mnist_siamese.prototxt"))
+        params, _ = net.init(jax.random.PRNGKey(0))
+        # second tower owns nothing: every param aliases tower one
+        assert "conv1_p" not in params and "feat_p" not in params
+        assert net.param_aliases[("conv1_p", "weight")] == ("conv1", "weight")
+
+    def test_contrastive_training_separates(self, rng):
+        sp = SolverParameter.from_text(
+            'base_lr: 0.01 momentum: 0.9 lr_policy: "fixed" max_iter: 80 '
+            'type: "SGD"')
+        sp.net_param = NetParameter.from_file(
+            "examples/siamese/mnist_siamese.prototxt")
+        solver = Solver(sp)
+        templates = rng.randn(4, 1, 28, 28).astype(np.float32)
+
+        def feed(it):
+            r = np.random.RandomState(it)
+            a_cls = r.randint(0, 4, 32)
+            sim = r.randint(0, 2, 32)
+            b_cls = np.where(sim, a_cls, (a_cls + 1 + r.randint(0, 3, 32)) % 4)
+            mk = lambda cls: templates[cls] + 0.15 * r.randn(32, 1, 28, 28).astype(np.float32)
+            return {"data": jnp.asarray(mk(a_cls)),
+                    "data_p": jnp.asarray(mk(b_cls)),
+                    "sim": jnp.asarray(sim.astype(np.float32))}
+
+        l0 = solver.step(1, feed)
+        lN = solver.step(79, feed)
+        assert lN < 0.5 * l0, f"contrastive loss not decreasing: {l0} -> {lN}"
+        # embeddings: same-class pairs closer than cross-class
+        fd = feed(10_000)
+        blobs, _, _ = solver.net.apply(solver.params, solver.net_state, fd,
+                                       train=False)
+        d = np.linalg.norm(np.array(blobs["feat"]) - np.array(blobs["feat_p"]),
+                           axis=1)
+        sim = np.array(fd["sim"])
+        assert d[sim == 1].mean() < d[sim == 0].mean()
+
+
+class TestCoordMap:
+    def test_conv_pool_composition(self):
+        net = NetParameter.from_text("""
+        layer { name: "in" type: "Input" top: "data"
+                input_param { shape { dim: 1 dim: 1 dim: 64 dim: 64 } } }
+        layer { name: "c" type: "Convolution" bottom: "data" top: "c"
+                convolution_param { num_output: 1 kernel_size: 3 pad: 1 } }
+        layer { name: "p" type: "Pooling" bottom: "c" top: "p"
+                pooling_param { kernel_size: 2 stride: 2 } }
+        """)
+        scale, offset = coord_map_from_to(net, "data", "p")
+        # pool stride 2: a data pixel maps to half-res coords
+        assert scale == 0.5
+        scale2, _ = coord_map_from_to(net, "p", "data")
+        assert scale2 == 2.0

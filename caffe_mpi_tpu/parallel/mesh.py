@@ -98,11 +98,22 @@ class MeshPlan:
         return NamedSharding(self.mesh, P(*spec))
 
     def shard_feeds(self, feeds, batch_axis: int = 0):
-        """device_put a feed pytree with the batch axis sharded over 'data'.
+        """Place a feed pytree with the batch axis sharded over 'data'.
         Batch dims must divide n_data (the reference rounds up with a
-        warning, parallel.cpp:284-293; here sharding requires exactness)."""
-        def put(x):
-            return jax.device_put(x, self.batch_sharded(x.ndim, batch_axis))
+        warning, parallel.cpp:284-293; here sharding requires exactness).
+
+        Single-host: plain device_put. Multi-host: each process passes its
+        LOCAL portion of the batch (rank-striped by the Feeder) and the
+        global array is assembled from process-local shards — the SPMD
+        analogue of the reference's per-node DataReader partitions feeding
+        one global allreduce domain."""
+        if jax.process_count() > 1:
+            def put(x):
+                sharding = self.batch_sharded(x.ndim, batch_axis)
+                return jax.make_array_from_process_local_data(sharding, x)
+        else:
+            def put(x):
+                return jax.device_put(x, self.batch_sharded(x.ndim, batch_axis))
         return jax.tree.map(put, feeds)
 
     def replicate(self, tree):

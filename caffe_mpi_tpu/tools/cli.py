@@ -129,7 +129,12 @@ def cmd_train(args) -> int:
     if hasattr(signal, "SIGHUP"):
         signal.signal(signal.SIGHUP, on_signal(args.sighup_effect))
 
-    feeder = _build_feeders(solver.net, "TRAIN")
+    # multi-host: each process reads its stripe of the global batch
+    # (reference CursorManager record striping, data_reader.hpp:28-53)
+    import jax as _jax
+    feeder = _build_feeders(solver.net, "TRAIN",
+                            rank=_jax.process_index(),
+                            world=_jax.process_count())
     if feeder is None:
         if not args.synthetic:
             log.error("net has no Data layer; pass -synthetic to train on "

@@ -498,6 +498,48 @@ class TestShapeOps:
         np.testing.assert_allclose(np.array(y), expect, rtol=1e-5)
 
 
+class TestMoreGradients:
+    """Gradient checks for structural ops (reference runs GradientChecker
+    on every layer; these cover the pure-movement ones)."""
+
+    @pytest.mark.parametrize("proto,shapes", [
+        ('type: "Concat" bottom: "a" bottom: "b" top: "y"',
+         [(2, 3, 4), (2, 2, 4)]),
+        ('type: "Slice" bottom: "x" top: "a" top: "b"\n'
+         'slice_param { axis: 1 slice_point: 2 }', [(2, 5)]),
+        ('type: "Flatten" bottom: "x" top: "y"', [(2, 3, 4)]),
+        ('type: "Tile" bottom: "x" top: "y" tile_param { tiles: 3 }',
+         [(2, 4)]),
+        ('type: "Reduction" bottom: "x" top: "y"\n'
+         'reduction_param { operation: SUMSQ axis: 1 }', [(3, 4)]),
+        ('type: "Eltwise" bottom: "a" bottom: "b" top: "y"\n'
+         'eltwise_param { operation: PROD }', [(2, 3), (2, 3)]),
+        ('type: "Scale" bottom: "x" top: "y" scale_param { bias_term: true }',
+         [(2, 3, 4)]),
+        ('type: "Bias" bottom: "x" top: "y"', [(2, 3, 4)]),
+        ('type: "MVN" bottom: "x" top: "y"', [(2, 3, 4, 4)]),
+        ('type: "LRN" bottom: "x" top: "y"\n'
+         'lrn_param { local_size: 3 norm_region: WITHIN_CHANNEL }',
+         [(1, 2, 5, 5)]),
+        ('type: "SPP" bottom: "x" top: "y" spp_param { pyramid_height: 2 }',
+         [(1, 2, 6, 6)]),
+    ], ids=lambda v: v[7:25] if isinstance(v, str) else "")
+    def test_gradients(self, proto, shapes, rng):
+        layer, params, state = make_layer(f'name: "l" {proto}', shapes)
+        bottoms = [rand(s, rng) for s in shapes]
+        check_gradients(layer, params, state, bottoms)
+
+    def test_crop_gradients(self, rng):
+        layer, params, state = make_layer(
+            'name: "c" type: "Crop" bottom: "x" bottom: "ref" top: "y"\n'
+            'crop_param { axis: 2 offset: 1 }',
+            [(1, 2, 5, 5), (1, 2, 3, 3)],
+        )
+        check_gradients(layer, params, state,
+                        [rand((1, 2, 5, 5), rng), rand((1, 2, 3, 3), rng)],
+                        bottoms_to_check=[0])
+
+
 class TestEmbed:
     def test_forward_and_grad(self, rng):
         layer, params, state = make_layer(

@@ -472,6 +472,39 @@ class RecurrentParameter(Message):
 
 
 @dataclass
+class DetectNetGroundTruthParameter(Message):
+    """Coverage-grid generation config (reference caffe.proto:511-549)."""
+    stride: int = 4
+    scale_cvg: float = 0.5
+    gridbox_type: str = "GRIDBOX_MAX"
+    max_cvg_len: int = 50
+    min_cvg_len: int = 50
+    coverage_type: str = "RECTANGULAR"
+    image_size_x: int = 1248
+    image_size_y: int = 384
+    obj_norm: bool = False
+    crop_bboxes: bool = True
+
+
+@dataclass
+class DetectNetAugmentationParameter(Message):
+    """Detection augmentation config (reference caffe.proto:552-583)."""
+    crop_prob: float = 1.0
+    shift_x: int = 0
+    shift_y: int = 0
+    scale_prob: float = 0.33
+    scale_min: float = 0.7
+    scale_max: float = 1.0
+    flip_prob: float = 0.33
+    rotation_prob: float = 0.33
+    max_rotate_degree: float = 1.0
+    hue_rotation_prob: float = 0.33
+    hue_rotation: float = 15.0
+    desaturation_prob: float = 0.33
+    desaturation_max: float = 0.5
+
+
+@dataclass
 class TransformationParameter(Message):
     """Data augmentation config (caffe.proto TransformationParameter;
     applied by the reference's DataTransformer, data_transformer.cpp)."""
@@ -630,6 +663,8 @@ class LayerParameter(Message):
     convolution_param: ConvolutionParameter | None = None
     crop_param: CropParameter | None = None
     data_param: DataParameter | None = None
+    detectnet_groundtruth_param: DetectNetGroundTruthParameter | None = None
+    detectnet_augmentation_param: DetectNetAugmentationParameter | None = None
     dropout_param: DropoutParameter | None = None
     dummy_data_param: DummyDataParameter | None = None
     eltwise_param: EltwiseParameter | None = None

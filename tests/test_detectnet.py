@@ -1,0 +1,108 @@
+"""DetectNet pipeline tests: coverage-grid generation, augmentation bbox
+consistency, feeder batches."""
+
+import numpy as np
+import pytest
+
+from caffe_mpi_tpu.data.detectnet import (
+    DetectNetAugmenter,
+    DetectNetFeeder,
+    coverage_label,
+)
+from caffe_mpi_tpu.proto import LayerParameter
+from caffe_mpi_tpu.proto.config import (
+    DetectNetAugmentationParameter,
+    DetectNetGroundTruthParameter,
+)
+
+
+def gt_param(**kw):
+    g = DetectNetGroundTruthParameter(image_size_x=64, image_size_y=32,
+                                      stride=4, **kw)
+    return g
+
+
+class TestCoverage:
+    def test_coverage_region_and_offsets(self):
+        gt = gt_param(scale_cvg=1.0)
+        bboxes = np.array([[0, 8, 8, 24, 16]], np.float32)
+        lab = coverage_label(bboxes, gt, num_classes=1)
+        assert lab.shape == (5, 8, 16)
+        cov = lab[0]
+        # covered cells span the bbox on the stride-4 grid
+        assert cov[2:4, 2:6].all() and cov.sum() == 8
+        # offset channel at cell (2,2): center=(10,10); dx1 = 8-10 = -2
+        assert lab[1, 2, 2] == pytest.approx(-2.0)
+        assert lab[3, 2, 2] == pytest.approx(24 - 10)
+
+    def test_scale_cvg_shrinks(self):
+        full = coverage_label(np.array([[0, 0, 0, 63, 31]]), gt_param(scale_cvg=1.0))
+        half = coverage_label(np.array([[0, 0, 0, 63, 31]]), gt_param(scale_cvg=0.4))
+        assert half[0].sum() < full[0].sum()
+
+    def test_multi_class_channels(self):
+        gt = gt_param()
+        bboxes = np.array([[1, 8, 8, 24, 16]], np.float32)
+        lab = coverage_label(bboxes, gt, num_classes=2)
+        assert lab.shape == (10, 8, 16)
+        assert lab[0].sum() == 0 and lab[5].sum() > 0
+
+
+class TestAugmenter:
+    def test_test_phase_deterministic_center(self):
+        gt = gt_param()
+        aug = DetectNetAugmenter(None, gt, phase="TEST")
+        img = np.random.RandomState(0).randint(
+            0, 256, (3, 48, 96)).astype(np.uint8)
+        boxes = np.array([[0, 30, 10, 60, 30]], np.float32)
+        rng = np.random.default_rng(0)
+        out1, b1 = aug(img, boxes, rng)
+        out2, b2 = aug(img, boxes, np.random.default_rng(99))
+        assert out1.shape == (3, 32, 64)
+        np.testing.assert_array_equal(out1, out2)  # TEST: no randomness
+        # center crop offset: (96-64)/2=16, (48-32)/2=8
+        np.testing.assert_allclose(b1[0], [0, 14, 2, 44, 22])
+
+    def test_flip_transforms_boxes(self):
+        gt = gt_param()
+        a = DetectNetAugmentationParameter(flip_prob=1.0, crop_prob=0.0,
+                                           scale_prob=0.0,
+                                           hue_rotation_prob=0.0,
+                                           desaturation_prob=0.0)
+        aug = DetectNetAugmenter(a, gt, phase="TRAIN")
+        img = np.zeros((3, 32, 64), np.uint8)
+        img[:, :, 0] = 255  # marker column at x=0
+        boxes = np.array([[0, 0, 0, 9, 9]], np.float32)
+        out, b = aug(img, boxes, np.random.default_rng(0))
+        assert out[0, 0, -1] == 255  # marker moved to the right edge
+        np.testing.assert_allclose(b[0], [0, 63 - 9, 0, 63, 9])
+
+
+class _ToyDetDataset:
+    def __init__(self, n=16):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def get(self, i):
+        r = np.random.RandomState(i)
+        img = r.randint(0, 256, (3, 32, 64)).astype(np.uint8)
+        boxes = np.array([[0, 10, 10, 30, 25]], np.float32)
+        return img, boxes
+
+
+class TestFeeder:
+    def test_batches(self):
+        lp = LayerParameter.from_text("""
+        name: "d" type: "Data" top: "data" top: "label"
+        data_param { batch_size: 4 }
+        detectnet_groundtruth_param { image_size_x: 64 image_size_y: 32 stride: 4 }
+        detectnet_augmentation_param { flip_prob: 0.5 }
+        """)
+        feeder = DetectNetFeeder(_ToyDetDataset(), lp, "TRAIN")
+        batch = feeder(0)
+        assert batch["data"].shape == (4, 3, 32, 64)
+        assert batch["label"].shape == (4, 5, 8, 16)
+        assert batch["label"][:, 0].sum() > 0  # coverage present
+        np.testing.assert_array_equal(feeder(3)["data"], feeder(3)["data"])

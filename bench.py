@@ -74,4 +74,19 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    # one retry IN A FRESH PROCESS: the TPU tunnel in this environment
+    # occasionally drops a claim, and jax caches the dead PJRT client, so
+    # an in-process retry would reuse the broken connection
+    try:
+        main()
+    except Exception:
+        import subprocess
+        import traceback
+        traceback.print_exc()
+        if os.environ.get("CAFFE_TPU_BENCH_RETRY") == "1":
+            sys.exit(1)
+        print("bench attempt 1 failed; retrying in a fresh process",
+              file=sys.stderr)
+        time.sleep(30)
+        env = dict(os.environ, CAFFE_TPU_BENCH_RETRY="1")
+        sys.exit(subprocess.run([sys.executable, __file__], env=env).returncode)

@@ -48,21 +48,45 @@ class PythonLayer(Layer):
 
     def apply(self, params, state, bottoms, *, train, rng):
         impl = self.impl
-        out_struct = [
-            jax.ShapeDtypeStruct(s, self.policy.forward)
-            for s in self.out_shapes
-        ]
+        out_structs = tuple(
+            jax.ShapeDtypeStruct(s, jnp.float32) for s in self.out_shapes)
 
         def host_forward(*arrays):
             outs = impl.forward([np.asarray(a) for a in arrays])
             return tuple(np.asarray(o, np.float32) for o in outs)
 
-        tops = jax.pure_callback(host_forward, tuple(
-            jax.ShapeDtypeStruct(s, jnp.float32) for s in self.out_shapes),
-            *bottoms)
-        tops = [t.astype(self.policy.forward) for t in tops]
-        if not hasattr(impl, "backward_jax"):
+        if hasattr(impl, "backward"):
+            # user-provided backward: numpy (top_diffs, bottoms) ->
+            # bottom_diffs, spliced in as a custom VJP through callbacks
+            @jax.custom_vjp
+            def fwd(*bs):
+                return jax.pure_callback(host_forward, out_structs, *bs)
+
+            def fwd_fwd(*bs):
+                return fwd(*bs), bs
+
+            def fwd_bwd(res, g):
+                bottoms_saved = res
+
+                def host_backward(*args):
+                    n_top = len(out_structs)
+                    top_diffs = [np.asarray(a) for a in args[:n_top]]
+                    bots = [np.asarray(a) for a in args[n_top:]]
+                    diffs = impl.backward(top_diffs, bots)
+                    return tuple(np.asarray(d, np.float32) for d in diffs)
+
+                in_structs = tuple(
+                    jax.ShapeDtypeStruct(b.shape, jnp.float32)
+                    for b in bottoms_saved)
+                return jax.pure_callback(host_backward, in_structs, *g,
+                                         *bottoms_saved)
+
+            fwd.defvjp(fwd_fwd, fwd_bwd)
+            tops = fwd(*bottoms)
+        else:
+            tops = jax.pure_callback(host_forward, out_structs, *bottoms)
             tops = [jax.lax.stop_gradient(t) for t in tops]
+        tops = [t.astype(self.policy.forward) for t in tops]
         return list(tops), state
 
 

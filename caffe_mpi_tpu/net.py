@@ -243,6 +243,15 @@ class Net:
                         )
             else:
                 bottoms = [env[b] for b in layer.lp.bottom]
+                # per-bottom gradient blocking (LayerParameter.propagate_down;
+                # reference net.cpp backward-need analysis honors it)
+                if layer.lp.propagate_down:
+                    bottoms = [
+                        jax.lax.stop_gradient(b)
+                        if i < len(layer.lp.propagate_down)
+                        and not layer.lp.propagate_down[i] else b
+                        for i, b in enumerate(bottoms)
+                    ]
             tops, lstate_new = layer.apply(lparams, lstate, bottoms,
                                            train=train, rng=lrng)
             if lstate_new is not lstate and lstate_new:

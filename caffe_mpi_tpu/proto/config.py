@@ -54,6 +54,16 @@ def _coerce_scalar(value: Any, target: type) -> Any:
 
 _SCHEMA_CACHE: dict[type, tuple] = {}
 
+# string-typed fields that are protobuf enums (printed unquoted)
+_ENUM_FIELD_NAMES = {
+    "pool", "operation", "norm_region", "backend", "phase", "variance_norm",
+    "norm", "round_mode", "engine", "solver_mode", "snapshot_format",
+    "regularization_type", "share_mode", "gridbox_type", "coverage_type",
+    "crop_mode", "forward_type", "backward_type", "forward_math",
+    "backward_math", "default_forward_type", "default_backward_type",
+    "default_forward_math", "default_backward_math", "solver_data_type",
+}
+
 
 @dataclass
 class Message:
@@ -111,6 +121,35 @@ class Message:
     @property
     def unknown_fields(self) -> list[str]:
         return getattr(self, "_unknown", [])
+
+    def to_node(self) -> PbNode:
+        """Serialize back to a text-format tree. Emits only fields that
+        differ from their defaults (proto2 printer behavior); enum-valued
+        string fields print unquoted."""
+        fields, hints = type(self)._schema()
+        node = PbNode()
+        for f in fields:
+            if f.name.startswith("_"):
+                continue
+            value = getattr(self, f.name)
+            default = (f.default_factory() if f.default_factory
+                       is not dataclasses.MISSING else f.default)
+            if value is None or value == default and not self.has(f.name):
+                continue
+            vals = value if isinstance(value, list) else [value]
+            if not vals and isinstance(value, list):
+                continue
+            for v in vals:
+                if isinstance(v, Message):
+                    node.add(f.name, v.to_node())
+                elif f.name in _ENUM_FIELD_NAMES and isinstance(v, str):
+                    node.add(f.name, PbEnum(v))
+                else:
+                    node.add(f.name, v)
+        return node
+
+    def to_prototxt(self) -> str:
+        return self.to_node().to_text()
 
     def has(self, name: str) -> bool:
         """proto2-style presence test: was the field set in the source text?"""

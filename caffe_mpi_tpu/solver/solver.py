@@ -83,15 +83,23 @@ class Solver:
 
         self.model_dir = model_dir
         train_param = _load_net_param(sp, "TRAIN", model_dir)
+        # train_state/test_state: extra stage/level selectors
+        # (reference solver.cpp:41-105 merges them into the NetState)
+        tstate = sp.train_state
         self.net = Net(train_param, phase="TRAIN", batch_divisor=batch_divisor,
-                       data_shape_probe=data_shape_probe, model_dir=model_dir)
+                       data_shape_probe=data_shape_probe, model_dir=model_dir,
+                       level=tstate.level if tstate else 0,
+                       stages=tuple(tstate.stage) if tstate else ())
         self.test_nets: list[Net] = []
         n_tests = max(len(sp.test_net), len(sp.test_net_param),
                       1 if (sp.net or sp.net_param is not None) and sp.test_iter else 0)
         for i in range(n_tests):
             tp = _load_net_param(sp, "TEST", model_dir, i)
+            ts = sp.test_state[i] if i < len(sp.test_state) else None
             self.test_nets.append(Net(tp, phase="TEST", model_dir=model_dir,
-                                      data_shape_probe=data_shape_probe))
+                                      data_shape_probe=data_shape_probe,
+                                      level=ts.level if ts else 0,
+                                      stages=tuple(ts.stage) if ts else ()))
 
         seed = sp.random_seed if sp.random_seed >= 0 else 0
         self.base_rng = jax.random.PRNGKey(seed)

@@ -1,0 +1,108 @@
+"""Tests for propagate_down, python-layer backward, solver train/test_state,
+Message.to_node serialization, and the upgrade tool."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from caffe_mpi_tpu.net import Net
+from caffe_mpi_tpu.proto import NetParameter, SolverParameter
+from caffe_mpi_tpu.solver import Solver
+
+
+class TestPropagateDown:
+    def test_blocks_gradient_per_bottom(self, rng):
+        text = """
+        layer { name: "in" type: "Input" top: "a" top: "b" top: "t"
+                input_param { shape { dim: 2 dim: 3 } shape { dim: 2 dim: 3 }
+                              shape { dim: 2 dim: 3 } } }
+        layer { name: "e" type: "Eltwise" bottom: "a" bottom: "b" top: "y"
+                propagate_down: true propagate_down: false }
+        layer { name: "loss" type: "EuclideanLoss" bottom: "y" bottom: "t" top: "l"
+                propagate_down: true propagate_down: false }
+        """
+        net = Net(NetParameter.from_text(text))
+        params, state = net.init(jax.random.PRNGKey(0))
+        feeds = {"a": jnp.asarray(rng.randn(2, 3).astype(np.float32)),
+                 "b": jnp.asarray(rng.randn(2, 3).astype(np.float32)),
+                 "t": jnp.asarray(rng.randn(2, 3).astype(np.float32))}
+        grads = jax.grad(
+            lambda f: net.apply(params, state, f, train=True)[2])(feeds)
+        assert float(jnp.sum(jnp.abs(grads["a"]))) > 0
+        assert float(jnp.sum(jnp.abs(grads["b"]))) == 0.0  # blocked
+
+
+class ScaledLayer:
+    """Python layer with a custom backward (x3 forward, x3 grads)."""
+
+    def infer_shapes(self, bottom_shapes):
+        return [bottom_shapes[0]]
+
+    def forward(self, bottoms):
+        return [3.0 * bottoms[0]]
+
+    def backward(self, top_diffs, bottoms):
+        return [3.0 * top_diffs[0]]
+
+
+class TestPythonLayerBackward:
+    def test_custom_vjp(self, rng):
+        net = Net(NetParameter.from_text("""
+        layer { name: "in" type: "Input" top: "x"
+                input_param { shape { dim: 2 dim: 4 } } }
+        layer { name: "py" type: "Python" bottom: "x" top: "y"
+                python_param { module: "test_misc_parity" layer: "ScaledLayer" } }
+        """))
+        params, state = net.init(jax.random.PRNGKey(0))
+        x = jnp.asarray(rng.randn(2, 4).astype(np.float32))
+
+        def loss(x):
+            blobs, _, _ = net.apply(params, state, {"x": x}, train=True)
+            return jnp.sum(blobs["y"] ** 2)
+
+        g = jax.grad(loss)(x)
+        # d/dx sum((3x)^2) = 18x
+        np.testing.assert_allclose(np.array(g), 18 * np.array(x), rtol=1e-5)
+
+
+class TestSolverStates:
+    def test_train_state_stage_selects_layers(self):
+        sp = SolverParameter.from_text("""
+        base_lr: 0.1 lr_policy: "fixed" max_iter: 1 type: "SGD"
+        train_state { stage: "with_aux" }
+        """)
+        sp.net_param = NetParameter.from_text("""
+        layer { name: "in" type: "Input" top: "x" top: "t"
+                input_param { shape { dim: 2 dim: 4 } shape { dim: 2 } } }
+        layer { name: "ip" type: "InnerProduct" bottom: "x" top: "y"
+                inner_product_param { num_output: 3
+                  weight_filler { type: "xavier" } } }
+        layer { name: "aux" type: "InnerProduct" bottom: "x" top: "aux"
+                include { stage: "with_aux" }
+                inner_product_param { num_output: 3
+                  weight_filler { type: "xavier" } } }
+        layer { name: "loss" type: "SoftmaxWithLoss" bottom: "y" bottom: "t"
+                top: "l" }
+        layer { name: "aux_loss" type: "SoftmaxWithLoss" bottom: "aux"
+                bottom: "t" top: "al" include { stage: "with_aux" } }
+        """)
+        solver = Solver(sp)
+        assert "aux" in [l.name for l in solver.net.layers]
+        sp2 = SolverParameter.from_text(
+            'base_lr: 0.1 lr_policy: "fixed" max_iter: 1 type: "SGD"')
+        sp2.net_param = sp.net_param
+        solver2 = Solver(sp2)
+        assert "aux" not in [l.name for l in solver2.net.layers]
+
+
+class TestToNode:
+    def test_roundtrip_real_model(self):
+        net = NetParameter.from_file("models/alexnet/train_val.prototxt")
+        text = net.to_prototxt()
+        again = NetParameter.from_text(text)
+        assert len(again.layer) == len(net.layer)
+        assert again.layer[1].convolution_param.num_output == \
+            net.layer[1].convolution_param.num_output
+        # enum fields unquoted
+        assert "pool: MAX" in text

@@ -252,8 +252,17 @@ class Net:
                         and not layer.lp.propagate_down[i] else b
                         for i, b in enumerate(bottoms)
                     ]
-            tops, lstate_new = layer.apply(lparams, lstate, bottoms,
-                                           train=train, rng=lrng)
+            apply_fn = layer.apply
+            if layer.lp.remat and train:
+                # recompute this layer's forward during backward instead of
+                # keeping its activations in HBM (layer-level remat)
+                apply_fn = jax.checkpoint(
+                    lambda p, s, b, layer=layer, lrng=lrng: layer.apply(
+                        p, s, b, train=True, rng=lrng))
+                tops, lstate_new = apply_fn(lparams, lstate, bottoms)
+            else:
+                tops, lstate_new = apply_fn(lparams, lstate, bottoms,
+                                            train=train, rng=lrng)
             if lstate_new is not lstate and lstate_new:
                 new_state[layer.name] = lstate_new
             for t, v in zip(layer.lp.top, tops):

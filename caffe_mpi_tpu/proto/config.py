@@ -689,6 +689,10 @@ class LayerParameter(Message):
     forward_math: str = ""
     backward_math: str = ""
     debug: bool = False
+    # TPU-native extension: rematerialize this layer's activations in the
+    # backward pass (jax.checkpoint) instead of storing them — the
+    # HBM-for-FLOPs trade the reference cannot express
+    remat: bool = False
 
     transform_param: TransformationParameter | None = None
     loss_param: LossParameter | None = None

@@ -91,6 +91,28 @@ class TestDataParallelTraining:
         for v in shard_vals[1:]:
             np.testing.assert_array_equal(shard_vals[0], v)
 
+    def test_mesh_with_iter_size(self):
+        """iter_size accumulation under SPMD sharding must equal the
+        single-device result too."""
+        data = batches(8)
+        stacked = [{k: jnp.concatenate([data[2 * i][k], data[2 * i + 1][k]])
+                    for k in data[0]} for i in range(4)]
+
+        def ms(mesh, iter_size):
+            sp = SolverParameter.from_text(
+                f'base_lr: 0.05 momentum: 0.9 lr_policy: "fixed" '
+                f'max_iter: 8 type: "SGD" random_seed: 7 iter_size: {iter_size}')
+            sp.net_param = NetParameter.from_text(NET)
+            return Solver(sp, mesh=mesh)
+
+        s_mesh = ms(MeshPlan.data_parallel(), 2)
+        s_one = ms(None, 2)
+        s_mesh.step(4, lambda it: data[it])
+        s_one.step(4, lambda it: data[it])
+        np.testing.assert_allclose(np.array(s_mesh.params["ip1"]["weight"]),
+                                   np.array(s_one.params["ip1"]["weight"]),
+                                   rtol=2e-4, atol=1e-6)
+
     def test_grad_transform_hook(self):
         """Custom allreduce hook (the P2PSync::allreduce analogue)."""
         calls = []

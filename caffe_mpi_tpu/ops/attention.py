@@ -105,10 +105,16 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
         return (out_new, new_m, l_new, kk, vv), None
 
     out0 = jnp.zeros_like(q)
+
     # mark the softmax stats as varying over the ring axis so the scan carry
     # types line up under shard_map's per-device type tracking
-    m0 = lax.pvary(jnp.full((b, h, s), -jnp.inf, q.dtype), (axis_name,))
-    l0 = lax.pvary(jnp.zeros((b, h, s), q.dtype), (axis_name,))
+    def _vary(x):
+        if hasattr(lax, "pcast"):
+            return lax.pcast(x, (axis_name,), to="varying")
+        return lax.pvary(x, (axis_name,))
+
+    m0 = _vary(jnp.full((b, h, s), -jnp.inf, q.dtype))
+    l0 = _vary(jnp.zeros((b, h, s), q.dtype))
     (out, m, l, _, _), _ = lax.scan(step, (out0, m0, l0, k, v),
                                     jnp.arange(n_dev))
     return out / jnp.maximum(l, 1e-30)[..., None].swapaxes(1, 2)

@@ -278,6 +278,18 @@ def cmd_time(args) -> int:
             g = jax.jit(jax.grad(f))
         else:
             g = jax.jit(f)
+        # compiled-program memory accounting (replaces the reference's
+        # hand-tallied per-net GPU byte report, net.cpp:386-400, with the
+        # compiler's actual buffer assignment)
+        try:
+            mem = g.lower(params, state, feeds).compile().memory_analysis()
+            if mem is not None:
+                print(f"  [{'train' if train else 'eval'} program] "
+                      f"temp {getattr(mem, 'temp_size_in_bytes', 0)/2**20:.1f} MiB, "
+                      f"args {getattr(mem, 'argument_size_in_bytes', 0)/2**20:.1f} MiB, "
+                      f"output {getattr(mem, 'output_size_in_bytes', 0)/2**20:.1f} MiB")
+        except Exception:
+            pass
         out = g(params, state, feeds)
         jax.block_until_ready(out)
         t0 = time.perf_counter()

@@ -1,0 +1,43 @@
+"""Dtype-matrix forward parity — the analogue of the reference's
+TYPED_TEST instantiation over {float, double, float16}
+(test_caffe_main.hpp:34-95): key layers run in bfloat16 and must track
+their f32 results within bf16 tolerance."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from caffe_mpi_tpu.core.types import DtypePolicy
+from gradcheck import make_layer
+
+BF16 = DtypePolicy(forward=jnp.bfloat16, backward=jnp.bfloat16)
+
+CASES = [
+    ('type: "Convolution" convolution_param { num_output: 4 kernel_size: 3 '
+     'pad: 1 weight_filler { type: "msra" } }', [(2, 3, 8, 8)]),
+    ('type: "Pooling" pooling_param { pool: MAX kernel_size: 2 stride: 2 }',
+     [(2, 3, 8, 8)]),
+    ('type: "Pooling" pooling_param { pool: AVE kernel_size: 3 stride: 2 pad: 1 }',
+     [(2, 3, 8, 8)]),
+    ('type: "LRN" lrn_param { local_size: 3 alpha: 0.1 }', [(2, 6, 4, 4)]),
+    ('type: "InnerProduct" inner_product_param { num_output: 5 '
+     'weight_filler { type: "xavier" } }', [(4, 12)]),
+    ('type: "BatchNorm" batch_norm_param { scale_bias: true }', [(4, 3, 6, 6)]),
+    ('type: "Softmax"', [(4, 7)]),
+    ('type: "TanH"', [(4, 7)]),
+]
+
+
+@pytest.mark.parametrize("proto,shapes", CASES,
+                         ids=[c[0][7:22] for c in CASES])
+def test_bf16_tracks_f32(proto, shapes, rng):
+    l32, params, state = make_layer(f'name: "l" {proto}', shapes)
+    l16, _, _ = make_layer(f'name: "l" {proto}', shapes, policy=BF16)
+    bottoms = [jnp.asarray(rng.randn(*s).astype(np.float32)) for s in shapes]
+    (y32,), _ = l32.apply(params, state, bottoms, train=False, rng=None)
+    (y16,), _ = l16.apply(params, state, bottoms, train=False, rng=None)
+    assert y16.dtype == jnp.bfloat16
+    scale = max(float(jnp.max(jnp.abs(y32))), 1e-3)
+    err = float(jnp.max(jnp.abs(y16.astype(jnp.float32) - y32))) / scale
+    assert err < 0.05, f"bf16 relative error {err:.3f}"

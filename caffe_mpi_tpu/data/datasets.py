@@ -244,6 +244,21 @@ class CIFAR10Dataset:
         return img[::-1], label  # -> BGR for Caffe parity
 
 
+class CachedDataset:
+    """Whole-dataset RAM cache (reference DataReader's DataCache,
+    data_reader.hpp:55-101: optional cache of every record with epoch
+    shuffling handled by the Feeder's permutations)."""
+
+    def __init__(self, base: Dataset):
+        self.records = [base.get(i) for i in range(len(base))]
+
+    def __len__(self) -> int:
+        return len(self.records)
+
+    def get(self, index: int) -> tuple[np.ndarray, int]:
+        return self.records[index]
+
+
 class SyntheticDataset:
     """Deterministic class-template images — test/bench stand-in."""
 

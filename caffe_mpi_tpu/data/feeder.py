@@ -156,6 +156,9 @@ def feeder_from_layer(lp, phase: str, *, rank: int = 0, world: int = 1,
     if lp.type == "Data":
         p = lp.data_param
         ds = open_dataset(str(p.backend), os.path.join(model_dir, p.source))
+        if p.cache:  # whole-DB RAM cache (reference data_param.cache)
+            from .datasets import CachedDataset
+            ds = CachedDataset(ds)
         shuffle = bool(p.shuffle) and phase == "TRAIN"
         return Feeder(ds, tf, p.batch_size, rank=rank, world=world,
                       shuffle=shuffle, top_names=tops,

@@ -236,7 +236,13 @@ class Solver:
                 self.test_all(test_feed_fns)
             micro_feeds = [feed_fn(self.iter * iter_size + k)
                            for k in range(iter_size)]
-            feeds_stack = jax.tree.map(lambda *xs: jnp.stack(xs), *micro_feeds)
+            if iter_size == 1:
+                # view, not copy: the common path skips the host-side stack
+                feeds_stack = jax.tree.map(lambda x: jnp.asarray(x)[None],
+                                           micro_feeds[0])
+            else:
+                feeds_stack = jax.tree.map(lambda *xs: jnp.stack(xs),
+                                           *micro_feeds)
             if self.mesh is not None:
                 # global batch sharded over the 'data' mesh axis
                 # (divide_batch_size semantics, parallel.cpp:295-348)

@@ -327,13 +327,38 @@ class DatumFileDataset:
         return len(offsets)
 
 
+class _HybridDatumDataset:
+    """Native mmap reader with per-record python fallback (encoded JPEG /
+    float datums parse on the python path)."""
+
+    def __init__(self, native_db, py_ds: DatumFileDataset):
+        self.native = native_db
+        self.py = py_ds
+
+    def __len__(self) -> int:
+        return len(self.py)
+
+    def get(self, index: int):
+        try:
+            return self.native.get(index)
+        except ValueError:
+            return self.py.get(index)
+
+
 def open_dataset(backend: str, source: str, **kw) -> Dataset:
     """db::GetDB analogue (reference db.cpp factory)."""
     backend = backend.upper()
     if backend == "LMDB":
         return LMDBDataset(source)
     if backend == "DATUMFILE":
-        return DatumFileDataset(source)
+        py = DatumFileDataset(source)
+        try:
+            from .. import native
+            if native.available():
+                return _HybridDatumDataset(native.NativeDatumDB(source), py)
+        except (ImportError, ValueError, RuntimeError):
+            pass
+        return py
     if backend == "LEVELDB":
         raise NotImplementedError(
             "LevelDB backend needs the plyvel/leveldb module (not in this "

@@ -27,6 +27,18 @@ def _load():
     lib = ctypes.CDLL(path)
     if lib.caffe_tpu_native_abi_version() != 1:
         return None
+    lib.caffe_tpu_db_open.restype = ctypes.c_void_p
+    lib.caffe_tpu_db_open.argtypes = [ctypes.c_char_p]
+    lib.caffe_tpu_db_count.restype = ctypes.c_int64
+    lib.caffe_tpu_db_count.argtypes = [ctypes.c_void_p]
+    lib.caffe_tpu_db_get.restype = ctypes.c_int
+    lib.caffe_tpu_db_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+    lib.caffe_tpu_db_close.restype = None
+    lib.caffe_tpu_db_close.argtypes = [ctypes.c_void_p]
     lib.caffe_tpu_transform_batch.restype = ctypes.c_int
     lib.caffe_tpu_transform_batch.argtypes = [
         ctypes.POINTER(ctypes.c_void_p),          # srcs
@@ -46,6 +58,53 @@ def _load():
 
 def available() -> bool:
     return _load() is not None
+
+
+class NativeDatumDB:
+    """mmap'd zero-copy datumfile reader (datumdb.cc); records parsed in C,
+    pixel pointers point into the map — no per-record Python work."""
+
+    def __init__(self, path: str):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library not built; run native/build.sh")
+        self._lib = lib
+        self._h = lib.caffe_tpu_db_open(path.encode())
+        if not self._h:
+            raise ValueError(f"{path}: not a readable datumfile")
+        self._n = lib.caffe_tpu_db_count(self._h)
+
+    def __len__(self) -> int:
+        return self._n
+
+    def get(self, index: int) -> tuple[np.ndarray, int]:
+        ptr = ctypes.c_void_p()
+        c = ctypes.c_int()
+        h = ctypes.c_int()
+        w = ctypes.c_int()
+        label = ctypes.c_int()
+        rc = self._lib.caffe_tpu_db_get(self._h, index, ctypes.byref(ptr),
+                                        ctypes.byref(c), ctypes.byref(h),
+                                        ctypes.byref(w), ctypes.byref(label))
+        if rc != 0:
+            raise ValueError(f"record {index}: native parse failed (rc {rc}; "
+                             "encoded/float datums use the python reader)")
+        size = c.value * h.value * w.value
+        arr = np.ctypeslib.as_array(
+            ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint8)), (size,))
+        # copy out of the mmap so the array outlives close()
+        return arr.reshape(c.value, h.value, w.value).copy(), label.value
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.caffe_tpu_db_close(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 def transform_batch(images: np.ndarray, record_ids: np.ndarray, *,

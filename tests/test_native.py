@@ -65,6 +65,24 @@ class TestNativeTransform:
                                    seed=10)
         assert not np.array_equal(a, c)  # different seed, different crops
 
+    def test_native_datumdb_reader(self, rng, tmp_path):
+        from caffe_mpi_tpu.data.datasets import (DatumFileDataset,
+                                                 encode_datum, open_dataset)
+        recs = [(rng.randint(0, 256, (3, 5, 6)).astype(np.uint8), i % 4)
+                for i in range(8)]
+        path = str(tmp_path / "t.datumdb")
+        DatumFileDataset.write(path, (encode_datum(a, l) for a, l in recs))
+        db = native.NativeDatumDB(path)
+        assert len(db) == 8
+        for i, (a, l) in enumerate(recs):
+            got, lab = db.get(i)
+            np.testing.assert_array_equal(got, a)
+            assert lab == l
+        db.close()
+        ds = open_dataset("DATUMFILE", path)
+        got, lab = ds.get(3)
+        np.testing.assert_array_equal(got, recs[3][0])
+
     def test_feeder_uses_native(self, rng):
         ds = SyntheticDataset(64, shape=(3, 16, 16))
         tp = TransformationParameter.from_text(

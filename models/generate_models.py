@@ -209,6 +209,120 @@ def resnet50(batch=32, bf16=False):
     return n
 
 
+def caffenet(batch=256):
+    """bvlc_reference_caffenet: AlexNet variant with pool-before-norm
+    (reference models/bvlc_reference_caffenet)."""
+    n = NetSpec("CaffeNet")
+    n.data, n.label = L.Input(ntop=2, input_param=dict(
+        shape=[dict(dim=[batch, 3, 227, 227]), dict(dim=[batch])]))
+    n.conv1, n.relu1 = conv_relu(n.data, 96, 11, stride=4)
+    n.pool1 = L.Pooling(n.relu1, pool="MAX", kernel_size=3, stride=2)
+    n.norm1 = L.LRN(n.pool1, local_size=5, alpha=1e-4, beta=0.75)
+    n.conv2, n.relu2 = conv_relu(n.norm1, 256, 5, pad=2, group=2)
+    n.pool2 = L.Pooling(n.relu2, pool="MAX", kernel_size=3, stride=2)
+    n.norm2 = L.LRN(n.pool2, local_size=5, alpha=1e-4, beta=0.75)
+    n.conv3, n.relu3 = conv_relu(n.norm2, 384, 3, pad=1)
+    n.conv4, n.relu4 = conv_relu(n.relu3, 384, 3, pad=1, group=2)
+    n.conv5, n.relu5 = conv_relu(n.relu4, 256, 3, pad=1, group=2)
+    n.pool5 = L.Pooling(n.relu5, pool="MAX", kernel_size=3, stride=2)
+    n.fc6 = L.InnerProduct(n.pool5, num_output=4096,
+                           weight_filler=dict(type="gaussian", std=0.005),
+                           bias_filler=dict(type="constant", value=1))
+    n.relu6 = L.ReLU(n.fc6, in_place=True)
+    n.drop6 = L.Dropout(n.fc6, dropout_ratio=0.5, in_place=True)
+    n.fc7 = L.InnerProduct(n.fc6, num_output=4096,
+                           weight_filler=dict(type="gaussian", std=0.005),
+                           bias_filler=dict(type="constant", value=1))
+    n.relu7 = L.ReLU(n.fc7, in_place=True)
+    n.drop7 = L.Dropout(n.fc7, dropout_ratio=0.5, in_place=True)
+    n.fc8 = L.InnerProduct(n.fc7, num_output=1000,
+                           weight_filler=dict(type="gaussian", std=0.01),
+                           bias_filler=dict(type="constant"))
+    train_test_tail(n, n.fc8)
+    return n
+
+
+def vgg16(batch=64):
+    """VGG-16 (reference models/vgg16)."""
+    n = NetSpec("VGG16")
+    n.data, n.label = L.Input(ntop=2, input_param=dict(
+        shape=[dict(dim=[batch, 3, 224, 224]), dict(dim=[batch])]))
+    x = n.data
+    cfg = [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]
+    for bi, (reps, ch) in enumerate(cfg, start=1):
+        for ri in range(1, reps + 1):
+            c = L.Convolution(x, num_output=ch, kernel_size=3, pad=1,
+                              weight_filler=dict(type="msra"),
+                              bias_filler=dict(type="constant"),
+                              param=[dict(lr_mult=1, decay_mult=1),
+                                     dict(lr_mult=2, decay_mult=0)])
+            r = L.ReLU(c, in_place=True)
+            setattr(n, f"conv{bi}_{ri}", c)
+            setattr(n, f"relu{bi}_{ri}", r)
+            x = r
+        p = L.Pooling(x, pool="MAX", kernel_size=2, stride=2)
+        setattr(n, f"pool{bi}", p)
+        x = p
+    n.fc6 = L.InnerProduct(x, num_output=4096,
+                           weight_filler=dict(type="gaussian", std=0.005),
+                           bias_filler=dict(type="constant"))
+    n.relu6 = L.ReLU(n.fc6, in_place=True)
+    n.drop6 = L.Dropout(n.fc6, dropout_ratio=0.5, in_place=True)
+    n.fc7 = L.InnerProduct(n.fc6, num_output=4096,
+                           weight_filler=dict(type="gaussian", std=0.005),
+                           bias_filler=dict(type="constant"))
+    n.relu7 = L.ReLU(n.fc7, in_place=True)
+    n.drop7 = L.Dropout(n.fc7, dropout_ratio=0.5, in_place=True)
+    n.fc8 = L.InnerProduct(n.fc7, num_output=1000,
+                           weight_filler=dict(type="gaussian", std=0.01),
+                           bias_filler=dict(type="constant"))
+    train_test_tail(n, n.fc8)
+    return n
+
+
+def resnet18(batch=64):
+    """ResNet-18: basic blocks [2,2,2,2] (reference models/resnet18)."""
+    n = NetSpec("ResNet18")
+    n.data, n.label = L.Input(ntop=2, input_param=dict(
+        shape=[dict(dim=[batch, 3, 224, 224]), dict(dim=[batch])]))
+
+    def conv_bn(b, nout, ks, stride=1, pad=0, relu=True):
+        c = L.Convolution(b, num_output=nout, kernel_size=ks, stride=stride,
+                          pad=pad, bias_term=False,
+                          weight_filler=dict(type="msra"),
+                          param=[dict(lr_mult=1, decay_mult=1)])
+        bn = L.BatchNorm(c, scale_bias=True, eps=1e-5,
+                         moving_average_fraction=0.9)
+        if relu:
+            return L.ReLU(bn, in_place=True)
+        return bn
+
+    def basic_block(b, nout, stride, project):
+        sc = conv_bn(b, nout, 1, stride=stride, relu=False) if project else b
+        x = conv_bn(b, nout, 3, stride=stride, pad=1)
+        x = conv_bn(x, nout, 3, pad=1, relu=False)
+        return L.ReLU(L.Eltwise(sc, x, operation="SUM"), in_place=True)
+
+    x = conv_bn(n.data, 64, 7, stride=2, pad=3)
+    n.conv1 = x
+    n.pool1 = L.Pooling(x, pool="MAX", kernel_size=3, stride=2)
+    x = n.pool1
+    for si, nout in enumerate([64, 128, 256, 512]):
+        for bi in range(2):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            x = basic_block(x, nout, stride,
+                            project=(bi == 0 and si > 0))
+            setattr(n, f"res{si + 2}{chr(ord('a') + bi)}", x)
+    n.pool5 = L.Pooling(x, pool="AVE", global_pooling=True)
+    n.fc1000 = L.InnerProduct(n.pool5, num_output=1000,
+                              weight_filler=dict(type="msra"),
+                              bias_filler=dict(type="constant"),
+                              param=[dict(lr_mult=1, decay_mult=1),
+                                     dict(lr_mult=2, decay_mult=0)])
+    train_test_tail(n, n.fc1000)
+    return n
+
+
 SOLVERS = {
     "alexnet": """# AlexNet solver (reference models/bvlc_alexnet/solver.prototxt recipe)
 net: "models/alexnet/train_val.prototxt"
@@ -252,6 +366,50 @@ weight_decay: 0.0002
 snapshot: 40000
 snapshot_prefix: "models/googlenet/bvlc_googlenet"
 """,
+    "caffenet": """# CaffeNet solver (reference bvlc_reference_caffenet recipe)
+net: "models/caffenet/train_val.prototxt"
+test_iter: 1000
+test_interval: 1000
+base_lr: 0.01
+lr_policy: "step"
+gamma: 0.1
+stepsize: 100000
+display: 20
+max_iter: 450000
+momentum: 0.9
+weight_decay: 0.0005
+snapshot: 10000
+snapshot_prefix: "models/caffenet/caffenet_train"
+""",
+    "vgg16": """# VGG-16 solver (reference models/vgg16 recipe class)
+net: "models/vgg16/train_val.prototxt"
+test_iter: 1000
+test_interval: 4000
+base_lr: 0.01
+lr_policy: "step"
+gamma: 0.1
+stepsize: 100000
+display: 40
+max_iter: 370000
+momentum: 0.9
+weight_decay: 0.0005
+snapshot: 20000
+snapshot_prefix: "models/vgg16/vgg16"
+""",
+    "resnet18": """# ResNet-18 solver (reference models/resnet18 recipe class)
+net: "models/resnet18/train_val.prototxt"
+test_iter: 1000
+test_interval: 5000
+base_lr: 0.1
+lr_policy: "poly"
+power: 1.0
+display: 100
+max_iter: 600000
+momentum: 0.9
+weight_decay: 0.0001
+snapshot: 25000
+snapshot_prefix: "models/resnet18/resnet18"
+""",
     "resnet50": """# ResNet-50 solver (reference models/resnet50/solver.prototxt recipe:
 # poly power=2, momentum 0.9, wd 1e-4; DGX-1-class batch-256 variant uses
 # base_lr 0.2 with warmup)
@@ -277,9 +435,12 @@ def main():
     out_root = os.path.dirname(os.path.abspath(__file__))
     nets = {
         "alexnet": alexnet(),
+        "caffenet": caffenet(),
         "cifar10_quick": cifar10_quick(),
         "googlenet": googlenet(),
+        "resnet18": resnet18(),
         "resnet50": resnet50(),
+        "vgg16": vgg16(),
     }
     for name, spec in nets.items():
         d = os.path.join(out_root, name)

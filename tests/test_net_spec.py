@@ -55,7 +55,9 @@ class TestNetSpec:
         """Regression: generators must not silently drop in-place layers."""
         import os
         for name, min_relus in [("alexnet", 7), ("googlenet", 50),
-                                ("resnet50", 45), ("cifar10_quick", 3)]:
+                                ("resnet50", 45), ("cifar10_quick", 3),
+                                ("caffenet", 7), ("vgg16", 15),
+                                ("resnet18", 16)]:
             path = f"models/{name}/train_val.prototxt"
             if not os.path.exists(path):
                 pytest.skip("models not generated")

@@ -209,6 +209,160 @@ def resnet50(batch=32, bf16=False):
     return n
 
 
+def alexnet_bn(batch=256):
+    """AlexNet with BatchNorm after each conv (reference models/alexnet_bn)."""
+    n = NetSpec("AlexNet_BN")
+    n.data, n.label = L.Input(ntop=2, input_param=dict(
+        shape=[dict(dim=[batch, 3, 227, 227]), dict(dim=[batch])]))
+
+    def cbr(name, b, nout, ks, stride=1, pad=0, group=1):
+        c = L.Convolution(b, num_output=nout, kernel_size=ks, stride=stride,
+                          pad=pad, group=group, bias_term=False,
+                          weight_filler=dict(type="msra"),
+                          param=[dict(lr_mult=1, decay_mult=1)])
+        bn = L.BatchNorm(c, scale_bias=True, moving_average_fraction=0.9)
+        r = L.ReLU(bn, in_place=True)
+        setattr(n, name, c)
+        setattr(n, f"{name}_bn", bn)
+        setattr(n, f"{name}_relu", r)
+        return r
+
+    x = cbr("conv1", n.data, 96, 11, stride=4)
+    n.pool1 = L.Pooling(x, pool="MAX", kernel_size=3, stride=2)
+    x = cbr("conv2", n.pool1, 256, 5, pad=2, group=2)
+    n.pool2 = L.Pooling(x, pool="MAX", kernel_size=3, stride=2)
+    x = cbr("conv3", n.pool2, 384, 3, pad=1)
+    x = cbr("conv4", x, 384, 3, pad=1, group=2)
+    x = cbr("conv5", x, 256, 3, pad=1, group=2)
+    n.pool5 = L.Pooling(x, pool="MAX", kernel_size=3, stride=2)
+    n.fc6 = L.InnerProduct(n.pool5, num_output=4096,
+                           weight_filler=dict(type="msra"),
+                           bias_filler=dict(type="constant"))
+    n.relu6 = L.ReLU(n.fc6, in_place=True)
+    n.drop6 = L.Dropout(n.fc6, dropout_ratio=0.5, in_place=True)
+    n.fc7 = L.InnerProduct(n.fc6, num_output=4096,
+                           weight_filler=dict(type="msra"),
+                           bias_filler=dict(type="constant"))
+    n.relu7 = L.ReLU(n.fc7, in_place=True)
+    n.drop7 = L.Dropout(n.fc7, dropout_ratio=0.5, in_place=True)
+    n.fc8 = L.InnerProduct(n.fc7, num_output=1000,
+                           weight_filler=dict(type="gaussian", std=0.01),
+                           bias_filler=dict(type="constant"))
+    train_test_tail(n, n.fc8)
+    return n
+
+
+def inception_v3(batch=64):
+    """Inception v3 (reference models/inception_v3): factorized 1x7/7x1
+    convolutions, grid reductions, 299x299 input."""
+    n = NetSpec("InceptionV3")
+    n.data, n.label = L.Input(ntop=2, input_param=dict(
+        shape=[dict(dim=[batch, 3, 299, 299]), dict(dim=[batch])]))
+    idx = [0]
+
+    def cbr(b, nout, kh, kw=None, stride=1, pad_h=0, pad_w=None):
+        kw = kh if kw is None else kw
+        pad_w = pad_h if pad_w is None else pad_w
+        idx[0] += 1
+        kwargs = dict(num_output=nout, bias_term=False,
+                      weight_filler=dict(type="msra"),
+                      param=[dict(lr_mult=1, decay_mult=1)])
+        if kh == kw:
+            kwargs.update(kernel_size=kh)
+        else:
+            kwargs.update(kernel_h=kh, kernel_w=kw)
+        if stride != 1:
+            kwargs.update(stride=stride)
+        if pad_h or pad_w:
+            if pad_h == pad_w:
+                kwargs.update(pad=pad_h)
+            else:
+                kwargs.update(pad_h=pad_h, pad_w=pad_w)
+        c = L.Convolution(b, **kwargs)
+        bn = L.BatchNorm(c, scale_bias=True, moving_average_fraction=0.9)
+        r = L.ReLU(bn, in_place=True)
+        setattr(n, f"conv{idx[0]}", c)
+        setattr(n, f"conv{idx[0]}_bn", bn)
+        setattr(n, f"conv{idx[0]}_relu", r)
+        return r
+
+    def block_a(x, pool_ch):
+        b1 = cbr(x, 64, 1)
+        b2 = cbr(cbr(x, 48, 1), 64, 5, pad_h=2)
+        b3 = cbr(cbr(cbr(x, 64, 1), 96, 3, pad_h=1), 96, 3, pad_h=1)
+        p = L.Pooling(x, pool="AVE", kernel_size=3, stride=1, pad=1)
+        b4 = cbr(p, pool_ch, 1)
+        return L.Concat(b1, b2, b3, b4)
+
+    def reduction_a(x):
+        b1 = cbr(x, 384, 3, stride=2)
+        b2 = cbr(cbr(cbr(x, 64, 1), 96, 3, pad_h=1), 96, 3, stride=2)
+        p = L.Pooling(x, pool="MAX", kernel_size=3, stride=2)
+        return L.Concat(b1, b2, p)
+
+    def block_b(x, ch7):
+        b1 = cbr(x, 192, 1)
+        b2 = cbr(cbr(cbr(x, ch7, 1), ch7, 1, 7, pad_h=0, pad_w=3),
+                 192, 7, 1, pad_h=3, pad_w=0)
+        b3 = x
+        b3 = cbr(b3, ch7, 1)
+        b3 = cbr(b3, ch7, 7, 1, pad_h=3, pad_w=0)
+        b3 = cbr(b3, ch7, 1, 7, pad_h=0, pad_w=3)
+        b3 = cbr(b3, ch7, 7, 1, pad_h=3, pad_w=0)
+        b3 = cbr(b3, 192, 1, 7, pad_h=0, pad_w=3)
+        p = L.Pooling(x, pool="AVE", kernel_size=3, stride=1, pad=1)
+        b4 = cbr(p, 192, 1)
+        return L.Concat(b1, b2, b3, b4)
+
+    def reduction_b(x):
+        b1 = cbr(cbr(x, 192, 1), 320, 3, stride=2)
+        b2 = cbr(cbr(cbr(cbr(x, 192, 1), 192, 1, 7, pad_h=0, pad_w=3),
+                     192, 7, 1, pad_h=3, pad_w=0), 192, 3, stride=2)
+        p = L.Pooling(x, pool="MAX", kernel_size=3, stride=2)
+        return L.Concat(b1, b2, p)
+
+    def block_c(x):
+        b1 = cbr(x, 320, 1)
+        b2r = cbr(x, 384, 1)
+        b2a = cbr(b2r, 384, 1, 3, pad_h=0, pad_w=1)
+        b2b = cbr(b2r, 384, 3, 1, pad_h=1, pad_w=0)
+        b3r = cbr(cbr(x, 448, 1), 384, 3, pad_h=1)
+        b3a = cbr(b3r, 384, 1, 3, pad_h=0, pad_w=1)
+        b3b = cbr(b3r, 384, 3, 1, pad_h=1, pad_w=0)
+        p = L.Pooling(x, pool="AVE", kernel_size=3, stride=1, pad=1)
+        b4 = cbr(p, 192, 1)
+        return L.Concat(b1, b2a, b2b, b3a, b3b, b4)
+
+    x = cbr(n.data, 32, 3, stride=2)        # 149
+    x = cbr(x, 32, 3)                        # 147
+    x = cbr(x, 64, 3, pad_h=1)               # 147
+    n.pool_s1 = L.Pooling(x, pool="MAX", kernel_size=3, stride=2)  # 73
+    x = cbr(n.pool_s1, 80, 1)
+    x = cbr(x, 192, 3)                       # 71
+    n.pool_s2 = L.Pooling(x, pool="MAX", kernel_size=3, stride=2)  # 35
+    x = block_a(n.pool_s2, 32)
+    x = block_a(x, 64)
+    x = block_a(x, 64)
+    n.mixed_a = x
+    x = reduction_a(x)                       # 17
+    for ch7 in (128, 160, 160, 192):
+        x = block_b(x, ch7)
+    n.mixed_b = x
+    x = reduction_b(x)                       # 8
+    x = block_c(x)
+    x = block_c(x)
+    n.mixed_c = x
+    n.pool_final = L.Pooling(x, pool="AVE", global_pooling=True)
+    n.drop = L.Dropout(n.pool_final, dropout_ratio=0.2, in_place=True)
+    n.fc1000 = L.InnerProduct(n.pool_final, num_output=1000,
+                              weight_filler=dict(type="msra"),
+                              bias_filler=dict(type="constant"),
+                              param=[dict(lr_mult=1, decay_mult=1),
+                                     dict(lr_mult=2, decay_mult=0)])
+    train_test_tail(n, n.fc1000)
+    return n
+
+
 def caffenet(batch=256):
     """bvlc_reference_caffenet: AlexNet variant with pool-before-norm
     (reference models/bvlc_reference_caffenet)."""
@@ -366,6 +520,35 @@ weight_decay: 0.0002
 snapshot: 40000
 snapshot_prefix: "models/googlenet/bvlc_googlenet"
 """,
+    "alexnet_bn": """# AlexNet-BN solver (reference models/alexnet_bn recipe class)
+net: "models/alexnet_bn/train_val.prototxt"
+test_iter: 1000
+test_interval: 1000
+base_lr: 0.02
+lr_policy: "poly"
+power: 1.0
+display: 20
+max_iter: 320000
+momentum: 0.9
+weight_decay: 0.0005
+snapshot: 10000
+snapshot_prefix: "models/alexnet_bn/alexnet_bn"
+""",
+    "inception_v3": """# Inception-v3 solver (reference models/inception_v3 recipe class)
+net: "models/inception_v3/train_val.prototxt"
+test_iter: 1000
+test_interval: 5000
+base_lr: 0.045
+lr_policy: "step"
+gamma: 0.94
+stepsize: 6400
+display: 100
+max_iter: 1200000
+momentum: 0.9
+weight_decay: 0.0001
+snapshot: 20000
+snapshot_prefix: "models/inception_v3/inception_v3"
+""",
     "caffenet": """# CaffeNet solver (reference bvlc_reference_caffenet recipe)
 net: "models/caffenet/train_val.prototxt"
 test_iter: 1000
@@ -435,9 +618,11 @@ def main():
     out_root = os.path.dirname(os.path.abspath(__file__))
     nets = {
         "alexnet": alexnet(),
+        "alexnet_bn": alexnet_bn(),
         "caffenet": caffenet(),
         "cifar10_quick": cifar10_quick(),
         "googlenet": googlenet(),
+        "inception_v3": inception_v3(),
         "resnet18": resnet18(),
         "resnet50": resnet50(),
         "vgg16": vgg16(),

@@ -51,6 +51,24 @@ class TestConvolution:
                        dilation=2, groups=2)
         np.testing.assert_allclose(np.array(y), ref.numpy(), rtol=1e-4, atol=1e-5)
 
+    def test_asymmetric_kernel_matches_torch(self, rng):
+        # 1x7 kernel with asymmetric padding (inception_v3's factorized conv)
+        layer, params, state = make_layer(
+            'name: "c" type: "Convolution" bottom: "x" top: "y"\n'
+            'convolution_param { num_output: 4 kernel_h: 1 kernel_w: 7\n'
+            '  pad_h: 0 pad_w: 3 weight_filler { type: "gaussian" std: 0.1 } }',
+            [(2, 3, 9, 9)],
+        )
+        x = rand((2, 3, 9, 9), rng)
+        (y,), _ = layer.apply(params, state, [x], train=False, rng=None)
+        ref = F.conv2d(torch.tensor(np.array(x)),
+                       torch.tensor(np.array(params["weight"])),
+                       torch.tensor(np.array(params["bias"])),
+                       padding=(0, 3))
+        np.testing.assert_allclose(np.array(y), ref.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+        assert y.shape == (2, 4, 9, 9)
+
     def test_gradients(self, rng):
         layer, params, state = make_layer(
             'name: "c" type: "Convolution" bottom: "x" top: "y"\n'

@@ -209,23 +209,51 @@ def resnet50(batch=32, bf16=False):
     return n
 
 
+def conv_bn_relu(n, name, bottom, nout, kh, kw=None, stride=1, pad_h=0,
+                 pad_w=None, group=1, eps=1e-4, filler="xavier", relu=True):
+    """conv (bias-free) -> BatchNorm (separate top, fused scale/bias,
+    eps 1e-4 like the reference BN zoo models) -> in-place ReLU.
+    Shared by alexnet_bn / inception_v3 / cifar10_nv generators."""
+    kw = kh if kw is None else kw
+    pad_w = pad_h if pad_w is None else pad_w
+    kwargs = dict(num_output=nout, bias_term=False,
+                  weight_filler=dict(type=filler),
+                  param=[dict(lr_mult=1, decay_mult=1)])
+    if kh == kw:
+        kwargs.update(kernel_size=kh)
+    else:
+        kwargs.update(kernel_h=kh, kernel_w=kw)
+    if stride != 1:
+        kwargs.update(stride=stride)
+    if pad_h == pad_w:
+        if pad_h:
+            kwargs.update(pad=pad_h)
+    else:
+        kwargs.update(pad_h=pad_h, pad_w=pad_w)
+    if group != 1:
+        kwargs.update(group=group)
+    c = L.Convolution(bottom, **kwargs)
+    bn = L.BatchNorm(c, scale_bias=True, eps=eps,
+                     moving_average_fraction=0.9)
+    setattr(n, name, c)
+    setattr(n, f"{name}/bn", bn)
+    if not relu:
+        return bn
+    r = L.ReLU(bn, in_place=True)
+    setattr(n, f"{name}/relu", r)
+    return r
+
+
 def alexnet_bn(batch=256):
-    """AlexNet with BatchNorm after each conv (reference models/alexnet_bn)."""
+    """AlexNet with BatchNorm after each conv (reference models/alexnet_bn;
+    BN eps 1e-4 per its train_val.prototxt)."""
     n = NetSpec("AlexNet_BN")
     n.data, n.label = L.Input(ntop=2, input_param=dict(
         shape=[dict(dim=[batch, 3, 227, 227]), dict(dim=[batch])]))
 
     def cbr(name, b, nout, ks, stride=1, pad=0, group=1):
-        c = L.Convolution(b, num_output=nout, kernel_size=ks, stride=stride,
-                          pad=pad, group=group, bias_term=False,
-                          weight_filler=dict(type="msra"),
-                          param=[dict(lr_mult=1, decay_mult=1)])
-        bn = L.BatchNorm(c, scale_bias=True, moving_average_fraction=0.9)
-        r = L.ReLU(bn, in_place=True)
-        setattr(n, name, c)
-        setattr(n, f"{name}_bn", bn)
-        setattr(n, f"{name}_relu", r)
-        return r
+        return conv_bn_relu(n, name, b, nout, ks, stride=stride, pad_h=pad,
+                            group=group, filler="msra")
 
     x = cbr("conv1", n.data, 96, 11, stride=4)
     n.pool1 = L.Pooling(x, pool="MAX", kernel_size=3, stride=2)
@@ -252,114 +280,137 @@ def alexnet_bn(batch=256):
     return n
 
 
-def inception_v3(batch=64):
-    """Inception v3 (reference models/inception_v3): factorized 1x7/7x1
-    convolutions, grid reductions, 299x299 input."""
+def inception_v3(batch=32):
+    """Inception v3, faithful to reference models/inception_v3/train_val
+    .prototxt: its NVCaffe stem (conv4=80 3x3, conv5=192 3x3/s2, conv6=288,
+    ONE stem maxpool), blocks 3A-3C / 4A-4E (ch7 128,160,160,192,192) /
+    5A-5B, reductions 3R/4R, aux heads loss1/loss2 (weight 0.3) after the
+    reductions, AVE k7 tail pool, reference layer names (e.g. 3A/p2_3x3)."""
     n = NetSpec("InceptionV3")
     n.data, n.label = L.Input(ntop=2, input_param=dict(
         shape=[dict(dim=[batch, 3, 299, 299]), dict(dim=[batch])]))
-    idx = [0]
 
-    def cbr(b, nout, kh, kw=None, stride=1, pad_h=0, pad_w=None):
-        kw = kh if kw is None else kw
-        pad_w = pad_h if pad_w is None else pad_w
-        idx[0] += 1
-        kwargs = dict(num_output=nout, bias_term=False,
-                      weight_filler=dict(type="msra"),
-                      param=[dict(lr_mult=1, decay_mult=1)])
-        if kh == kw:
-            kwargs.update(kernel_size=kh)
-        else:
-            kwargs.update(kernel_h=kh, kernel_w=kw)
-        if stride != 1:
-            kwargs.update(stride=stride)
-        if pad_h or pad_w:
-            if pad_h == pad_w:
-                kwargs.update(pad=pad_h)
-            else:
-                kwargs.update(pad_h=pad_h, pad_w=pad_w)
-        c = L.Convolution(b, **kwargs)
-        bn = L.BatchNorm(c, scale_bias=True, moving_average_fraction=0.9)
-        r = L.ReLU(bn, in_place=True)
-        setattr(n, f"conv{idx[0]}", c)
-        setattr(n, f"conv{idx[0]}_bn", bn)
-        setattr(n, f"conv{idx[0]}_relu", r)
-        return r
+    def cbr(name, b, nout, kh, kw=None, stride=1, pad_h=0, pad_w=None):
+        return conv_bn_relu(n, name, b, nout, kh, kw, stride=stride,
+                            pad_h=pad_h, pad_w=pad_w)
 
-    def block_a(x, pool_ch):
-        b1 = cbr(x, 64, 1)
-        b2 = cbr(cbr(x, 48, 1), 64, 5, pad_h=2)
-        b3 = cbr(cbr(cbr(x, 64, 1), 96, 3, pad_h=1), 96, 3, pad_h=1)
-        p = L.Pooling(x, pool="AVE", kernel_size=3, stride=1, pad=1)
-        b4 = cbr(p, pool_ch, 1)
-        return L.Concat(b1, b2, b3, b4)
+    def block_a(p, x):
+        b1 = cbr(f"{p}/p1_1x1", x, 64, 1)
+        b2 = cbr(f"{p}/p2_3x3", cbr(f"{p}/p2_1x1", x, 64, 1), 96, 3, pad_h=1)
+        b3 = cbr(f"{p}/p3_1x1", x, 48, 1)
+        b3 = cbr(f"{p}/p3_3x3a", b3, 64, 3, pad_h=1)
+        b3 = cbr(f"{p}/p3_3x3b", b3, 64, 3, pad_h=1)
+        pool = L.Pooling(x, pool="AVE", kernel_size=3, stride=1, pad=1)
+        setattr(n, f"{p}/p4_pool", pool)
+        b4 = cbr(f"{p}/p4_1x1", pool, 64, 1)
+        out = L.Concat(b1, b2, b3, b4)
+        setattr(n, f"{p}/concat", out)
+        return out
 
-    def reduction_a(x):
-        b1 = cbr(x, 384, 3, stride=2)
-        b2 = cbr(cbr(cbr(x, 64, 1), 96, 3, pad_h=1), 96, 3, stride=2)
-        p = L.Pooling(x, pool="MAX", kernel_size=3, stride=2)
-        return L.Concat(b1, b2, p)
+    def block_b(p, x, ch7):
+        b1 = cbr(f"{p}/p1_1x1", x, 192, 1)
+        b2 = cbr(f"{p}/p2_1x1", x, ch7, 1)
+        b2 = cbr(f"{p}/p2_1x7", b2, ch7, 1, 7, pad_h=0, pad_w=3)
+        b2 = cbr(f"{p}/p2_7x1", b2, 192, 7, 1, pad_h=3, pad_w=0)
+        b3 = cbr(f"{p}/p3_1x1", x, ch7, 1)
+        b3 = cbr(f"{p}/p3_1x7a", b3, ch7, 1, 7, pad_h=0, pad_w=3)
+        b3 = cbr(f"{p}/p3_7x1a", b3, ch7, 7, 1, pad_h=3, pad_w=0)
+        b3 = cbr(f"{p}/p3_1x7b", b3, ch7, 1, 7, pad_h=0, pad_w=3)
+        b3 = cbr(f"{p}/p3_7x1b", b3, 192, 7, 1, pad_h=3, pad_w=0)
+        pool = L.Pooling(x, pool="AVE", kernel_size=3, stride=1, pad=1)
+        setattr(n, f"{p}/p4_pool", pool)
+        b4 = cbr(f"{p}/p4_1x1", pool, 192, 1)
+        out = L.Concat(b1, b2, b3, b4)
+        setattr(n, f"{p}/concat", out)
+        return out
 
-    def block_b(x, ch7):
-        b1 = cbr(x, 192, 1)
-        b2 = cbr(cbr(cbr(x, ch7, 1), ch7, 1, 7, pad_h=0, pad_w=3),
-                 192, 7, 1, pad_h=3, pad_w=0)
-        b3 = x
-        b3 = cbr(b3, ch7, 1)
-        b3 = cbr(b3, ch7, 7, 1, pad_h=3, pad_w=0)
-        b3 = cbr(b3, ch7, 1, 7, pad_h=0, pad_w=3)
-        b3 = cbr(b3, ch7, 7, 1, pad_h=3, pad_w=0)
-        b3 = cbr(b3, 192, 1, 7, pad_h=0, pad_w=3)
-        p = L.Pooling(x, pool="AVE", kernel_size=3, stride=1, pad=1)
-        b4 = cbr(p, 192, 1)
-        return L.Concat(b1, b2, b3, b4)
+    def block_c(p, x):
+        b1 = cbr(f"{p}/p1_1x1", x, 320, 1)
+        b2r = cbr(f"{p}/p2_1x1", x, 384, 1)
+        b2a = cbr(f"{p}/p2_1x3", b2r, 384, 1, 3, pad_h=0, pad_w=1)
+        b2b = cbr(f"{p}/p2_3x1", b2r, 384, 3, 1, pad_h=1, pad_w=0)
+        b2 = L.Concat(b2a, b2b)
+        setattr(n, f"{p}/p2_concat", b2)
+        b3r = cbr(f"{p}/p3_3x3", cbr(f"{p}/p3_1x1", x, 448, 1), 384, 3,
+                  pad_h=1)
+        b3a = cbr(f"{p}/p3_1x3", b3r, 384, 1, 3, pad_h=0, pad_w=1)
+        b3b = cbr(f"{p}/p3_3x1", b3r, 384, 3, 1, pad_h=1, pad_w=0)
+        b3 = L.Concat(b3a, b3b)
+        setattr(n, f"{p}/p3_concat", b3)
+        pool = L.Pooling(x, pool="AVE", kernel_size=3, stride=1, pad=1)
+        setattr(n, f"{p}/p4_pool", pool)
+        b4 = cbr(f"{p}/p4_1x1", pool, 192, 1)
+        out = L.Concat(b1, b2, b3, b4)
+        setattr(n, f"{p}/concat", out)
+        return out
 
-    def reduction_b(x):
-        b1 = cbr(cbr(x, 192, 1), 320, 3, stride=2)
-        b2 = cbr(cbr(cbr(cbr(x, 192, 1), 192, 1, 7, pad_h=0, pad_w=3),
-                     192, 7, 1, pad_h=3, pad_w=0), 192, 3, stride=2)
-        p = L.Pooling(x, pool="MAX", kernel_size=3, stride=2)
-        return L.Concat(b1, b2, p)
+    def aux_head(p, x):
+        pool = L.Pooling(x, pool="AVE", kernel_size=5, stride=3)
+        setattr(n, f"{p}/pool", pool)
+        conv = cbr(f"{p}/conv", pool, 128, 1)
+        fc1 = L.InnerProduct(conv, num_output=1024,
+                             weight_filler=dict(type="xavier"),
+                             bias_filler=dict(type="constant"))
+        setattr(n, f"{p}/fc1", fc1)
+        setattr(n, f"{p}/fc1_relu", L.ReLU(fc1, in_place=True))
+        fc2 = L.InnerProduct(fc1, num_output=1000,
+                             weight_filler=dict(type="xavier"),
+                             bias_filler=dict(type="constant"))
+        setattr(n, f"{p}/fc2", fc2)
+        setattr(n, f"{p}/loss", L.SoftmaxWithLoss(fc2, n.label,
+                                                  loss_weight=0.3))
+        setattr(n, f"{p}/top-1", L.Accuracy(fc2, n.label,
+                                            include=dict(phase="TEST")))
+        setattr(n, f"{p}/top-5", L.Accuracy(fc2, n.label, top_k=5,
+                                            include=dict(phase="TEST")))
 
-    def block_c(x):
-        b1 = cbr(x, 320, 1)
-        b2r = cbr(x, 384, 1)
-        b2a = cbr(b2r, 384, 1, 3, pad_h=0, pad_w=1)
-        b2b = cbr(b2r, 384, 3, 1, pad_h=1, pad_w=0)
-        b3r = cbr(cbr(x, 448, 1), 384, 3, pad_h=1)
-        b3a = cbr(b3r, 384, 1, 3, pad_h=0, pad_w=1)
-        b3b = cbr(b3r, 384, 3, 1, pad_h=1, pad_w=0)
-        p = L.Pooling(x, pool="AVE", kernel_size=3, stride=1, pad=1)
-        b4 = cbr(p, 192, 1)
-        return L.Concat(b1, b2a, b2b, b3a, b3b, b4)
-
-    x = cbr(n.data, 32, 3, stride=2)        # 149
-    x = cbr(x, 32, 3)                        # 147
-    x = cbr(x, 64, 3, pad_h=1)               # 147
-    n.pool_s1 = L.Pooling(x, pool="MAX", kernel_size=3, stride=2)  # 73
-    x = cbr(n.pool_s1, 80, 1)
-    x = cbr(x, 192, 3)                       # 71
-    n.pool_s2 = L.Pooling(x, pool="MAX", kernel_size=3, stride=2)  # 35
-    x = block_a(n.pool_s2, 32)
-    x = block_a(x, 64)
-    x = block_a(x, 64)
-    n.mixed_a = x
-    x = reduction_a(x)                       # 17
-    for ch7 in (128, 160, 160, 192):
-        x = block_b(x, ch7)
-    n.mixed_b = x
-    x = reduction_b(x)                       # 8
-    x = block_c(x)
-    x = block_c(x)
-    n.mixed_c = x
-    n.pool_final = L.Pooling(x, pool="AVE", global_pooling=True)
-    n.drop = L.Dropout(n.pool_final, dropout_ratio=0.2, in_place=True)
-    n.fc1000 = L.InnerProduct(n.pool_final, num_output=1000,
-                              weight_filler=dict(type="msra"),
-                              bias_filler=dict(type="constant"),
-                              param=[dict(lr_mult=1, decay_mult=1),
-                                     dict(lr_mult=2, decay_mult=0)])
-    train_test_tail(n, n.fc1000)
+    x = cbr("conv1", n.data, 32, 3, stride=2)           # 149
+    x = cbr("conv2", x, 32, 3)                          # 147
+    x = cbr("conv3", x, 64, 3, pad_h=1)                 # 147
+    n.pool1 = L.Pooling(x, pool="MAX", kernel_size=3, stride=2)  # 73
+    x = cbr("conv4", n.pool1, 80, 3)                    # 71
+    x = cbr("conv5", x, 192, 3, stride=2)               # 35
+    x = cbr("conv6", x, 288, 3, pad_h=1)                # 35
+    for p in ("3A", "3B", "3C"):
+        x = block_a(p, x)
+    # 3R reduction -> 17x17
+    r1 = cbr("3R/p1_1x1", x, 64, 1)
+    r1 = cbr("3R/p1_3x3a", r1, 96, 3, pad_h=1)
+    r1 = cbr("3R/p1_3x3b", r1, 96, 3, stride=2)
+    r2 = cbr("3R/p2_3x3", x, 384, 3, stride=2)
+    rp = L.Pooling(x, pool="MAX", kernel_size=3, stride=2)
+    setattr(n, "3R/p3_pool", rp)
+    x = L.Concat(r1, r2, rp)
+    setattr(n, "3R/concat", x)
+    aux_head("loss1", x)
+    for p, ch7 in zip(("4A", "4B", "4C", "4D", "4E"),
+                      (128, 160, 160, 192, 192)):
+        x = block_b(p, x, ch7)
+    # 4R reduction -> 8x8
+    r1 = cbr("4R/p1_1x1", x, 192, 1)
+    r1 = cbr("4R/p1_3x3", r1, 320, 3, stride=2)
+    r2 = cbr("4R/p2_1x1", x, 192, 1)
+    r2 = cbr("4R/p2_1x7", r2, 192, 1, 7, pad_h=0, pad_w=3)
+    r2 = cbr("4R/p2_7x1", r2, 192, 7, 1, pad_h=3, pad_w=0)
+    r2 = cbr("4R/p2_3x3", r2, 192, 3, stride=2)
+    rp = L.Pooling(x, pool="MAX", kernel_size=3, stride=2)
+    setattr(n, "4R/p3_pool", rp)
+    x = L.Concat(r1, r2, rp)
+    setattr(n, "4R/concat", x)
+    aux_head("loss2", x)
+    for p in ("5A", "5B"):
+        x = block_c(p, x)
+    pool = L.Pooling(x, pool="AVE", kernel_size=7, stride=1)
+    setattr(n, "loss/pool", pool)
+    fc = L.InnerProduct(pool, num_output=1000,
+                        weight_filler=dict(type="xavier"),
+                        bias_filler=dict(type="constant"))
+    setattr(n, "loss/fc", fc)
+    n.loss = L.SoftmaxWithLoss(fc, n.label)
+    setattr(n, "accuracy/top-1", L.Accuracy(fc, n.label,
+                                            include=dict(phase="TEST")))
+    setattr(n, "accuracy/top-5", L.Accuracy(fc, n.label, top_k=5,
+                                            include=dict(phase="TEST")))
     return n
 
 
@@ -477,6 +528,87 @@ def resnet18(batch=64):
     return n
 
 
+def cifar10_nv(batch=128):
+    """cifar10_nv (reference models/cifar10_nv/cifar10_nv_train_test
+    .prototxt): all-convolutional — 3x [128 3x3] with BN on conv3, pool,
+    3x [256 3x3] with BN on conv6, pool, 320 3x3 / 320 1x1 / 10 1x1 head,
+    AVE k5 pool; 28x28 crops of CIFAR images."""
+    n = NetSpec("CIFAR10_nv")
+    n.data, n.label = L.Input(ntop=2, input_param=dict(
+        shape=[dict(dim=[batch, 3, 28, 28]), dict(dim=[batch])]))
+
+    def cr(name, b, nout, ks, pad=0):
+        c = L.Convolution(b, num_output=nout, kernel_size=ks, pad=pad,
+                          weight_filler=dict(type="xavier"),
+                          bias_filler=dict(type="constant"),
+                          param=[dict(lr_mult=1), dict(lr_mult=2)])
+        r = L.ReLU(c, in_place=True)
+        setattr(n, name, c)
+        setattr(n, f"{name}_relu", r)
+        return r
+
+    def cbnr(name, b, nout, ks, pad=0):
+        # bn'd convs (conv3/conv6): bias-free conv + BN eps 1e-4 + ReLU
+        return conv_bn_relu(n, name, b, nout, ks, pad_h=pad)
+
+    x = cr("conv1", n.data, 128, 3, pad=1)
+    x = cr("conv2", x, 128, 3, pad=1)
+    x = cbnr("conv3", x, 128, 3, pad=1)
+    n.pool3 = L.Pooling(x, pool="MAX", kernel_size=3, stride=2)
+    x = cr("conv4", n.pool3, 256, 3, pad=1)
+    x = cr("conv5", x, 256, 3, pad=1)
+    x = cbnr("conv6", x, 256, 3, pad=1)
+    n.pool6 = L.Pooling(x, pool="MAX", kernel_size=3, stride=2)
+    x = cr("conv7", n.pool6, 320, 3)
+    x = cr("conv8", x, 320, 1)
+    x = cr("conv9", x, 10, 1)
+    n.pool9 = L.Pooling(x, pool="AVE", kernel_size=5)
+    train_test_tail(n, n.pool9)
+    return n
+
+
+def rcnn(batch=10):
+    """R-CNN classifier head (reference models/rcnn, ilsvrc13 200-way):
+    CaffeNet body with an fc-rcnn scoring layer; deploy-style for use
+    with the Detector wrapper."""
+    spec = NetSpec("R-CNN-ilsvrc13")
+    spec.data = L.Input(input_param=dict(
+        shape=dict(dim=[batch, 3, 227, 227])))
+    # reuse the caffenet body topology by regenerating it on spec
+    prev = spec.data
+    body = [("conv1", 96, 11, 4, 0, 1, True), ("conv2", 256, 5, 1, 2, 2, True),
+            ("conv3", 384, 3, 1, 1, 1, False), ("conv4", 384, 3, 1, 1, 2, False),
+            ("conv5", 256, 3, 1, 1, 2, True)]
+    norms = {"conv1", "conv2"}
+    for name, nout, ks, st, pad, grp, pool in body:
+        c = L.Convolution(prev, num_output=nout, kernel_size=ks, stride=st,
+                          pad=pad, group=grp,
+                          weight_filler=dict(type="gaussian", std=0.01),
+                          bias_filler=dict(type="constant"))
+        r = L.ReLU(c, in_place=True)
+        setattr(spec, name, c)
+        setattr(spec, f"{name}_relu", r)
+        prev = r
+        if pool:
+            p = L.Pooling(prev, pool="MAX", kernel_size=3, stride=2)
+            setattr(spec, f"pool_{name}", p)
+            prev = p
+        if name in norms:
+            nm = L.LRN(prev, local_size=5, alpha=1e-4, beta=0.75)
+            setattr(spec, f"norm_{name}", nm)
+            prev = nm
+    spec.fc6 = L.InnerProduct(prev, num_output=4096,
+                              weight_filler=dict(type="gaussian", std=0.005))
+    spec.relu6 = L.ReLU(spec.fc6, in_place=True)
+    spec.fc7 = L.InnerProduct(spec.fc6, num_output=4096,
+                              weight_filler=dict(type="gaussian", std=0.005))
+    spec.relu7 = L.ReLU(spec.fc7, in_place=True)
+    setattr(spec, "fc-rcnn", L.InnerProduct(
+        spec.fc7, num_output=200,
+        weight_filler=dict(type="gaussian", std=0.01)))
+    return spec
+
+
 SOLVERS = {
     "alexnet": """# AlexNet solver (reference models/bvlc_alexnet/solver.prototxt recipe)
 net: "models/alexnet/train_val.prototxt"
@@ -520,30 +652,47 @@ weight_decay: 0.0002
 snapshot: 40000
 snapshot_prefix: "models/googlenet/bvlc_googlenet"
 """,
-    "alexnet_bn": """# AlexNet-BN solver (reference models/alexnet_bn recipe class)
-net: "models/alexnet_bn/train_val.prototxt"
-test_iter: 1000
-test_interval: 1000
-base_lr: 0.02
+    "cifar10_nv": """# cifar10_nv solver (reference models/cifar10_nv/cifar10_nv_solver.prototxt)
+net: "models/cifar10_nv/train_val.prototxt"
+test_iter: 20
+test_interval: 400
+display: 100
+max_iter: 100000
 lr_policy: "poly"
-power: 1.0
-display: 20
-max_iter: 320000
+base_lr: 0.01
+power: 2
+momentum: 0.9
+weight_decay: 0.004
+snapshot: 1000000
+snapshot_prefix: "models/cifar10_nv/cifar10_nv"
+snapshot_after_train: false
+""",
+    "alexnet_bn": """# AlexNet-BN solver (reference models/alexnet_bn/solver.prototxt)
+net: "models/alexnet_bn/train_val.prototxt"
+test_iter: 195
+test_interval: 5000
+test_initialization: false
+display: 100
+max_iter: 150000
+lr_policy: "poly"
+base_lr: 0.02
+power: 2.0
 momentum: 0.9
 weight_decay: 0.0005
-snapshot: 10000
+snapshot: 500000
 snapshot_prefix: "models/alexnet_bn/alexnet_bn"
 """,
-    "inception_v3": """# Inception-v3 solver (reference models/inception_v3 recipe class)
+    "inception_v3": """# Inception-v3 solver (reference models/inception_v3/solver.prototxt;
+# DGX-1 batch-256 variant: max_iter 300000, base_lr 0.2)
 net: "models/inception_v3/train_val.prototxt"
-test_iter: 1000
-test_interval: 5000
-base_lr: 0.045
-lr_policy: "step"
-gamma: 0.94
-stepsize: 6400
+test_iter: 1563
+test_interval: 20000
+test_initialization: false
 display: 100
-max_iter: 1200000
+max_iter: 2400000
+base_lr: 0.05
+lr_policy: "poly"
+power: 2
 momentum: 0.9
 weight_decay: 0.0001
 snapshot: 20000
@@ -626,7 +775,14 @@ def main():
         "resnet18": resnet18(),
         "resnet50": resnet50(),
         "vgg16": vgg16(),
+        "cifar10_nv": cifar10_nv(),
     }
+    # deploy-only model (no solver): rcnn
+    d = os.path.join(out_root, "rcnn")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "deploy.prototxt"), "w") as f:
+        f.write(rcnn().to_prototxt() + "\n")
+    print("wrote models/rcnn/ (deploy only)")
     for name, spec in nets.items():
         d = os.path.join(out_root, name)
         os.makedirs(d, exist_ok=True)

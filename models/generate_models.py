@@ -100,7 +100,8 @@ def cifar10_quick(batch=100):
 
 
 def inception(n, name, bottom, o1, o3r, o3, o5r, o5, op):
-    """GoogLeNet inception module."""
+    """GoogLeNet inception module (reference layer names:
+    inception_Xy/1x1 etc., so reference .caffemodel weights load by name)."""
     def cr(branch, b, nout, ks, pad=0):
         c = L.Convolution(b, num_output=nout, kernel_size=ks, pad=pad,
                           weight_filler=dict(type="xavier"),
@@ -108,8 +109,8 @@ def inception(n, name, bottom, o1, o3r, o3, o5r, o5, op):
                           param=[dict(lr_mult=1, decay_mult=1),
                                  dict(lr_mult=2, decay_mult=0)])
         r = L.ReLU(c, in_place=True)
-        setattr(n, f"{name}_{branch}", c)
-        setattr(n, f"{name}_relu_{branch}", r)
+        setattr(n, f"{name}/{branch}", c)
+        setattr(n, f"{name}/relu_{branch}", r)
         return r
 
     c1 = cr("1x1", bottom, o1, 1)
@@ -118,45 +119,106 @@ def inception(n, name, bottom, o1, o3r, o3, o5r, o5, op):
     c5r = cr("5x5_reduce", bottom, o5r, 1)
     c5 = cr("5x5", c5r, o5, 5, pad=2)
     pool = L.Pooling(bottom, pool="MAX", kernel_size=3, stride=1, pad=1)
-    setattr(n, f"{name}_pool", pool)
+    setattr(n, f"{name}/pool", pool)
     cp = cr("pool_proj", pool, op, 1)
     out = L.Concat(c1, c3, c5, cp)
-    setattr(n, f"{name}_output", out)
+    setattr(n, f"{name}/output", out)
     return out
 
 
+def _googlenet_aux(n, prefix, bottom, label):
+    """Aux classifier head (reference loss1/* and loss2/*)."""
+    pool = L.Pooling(bottom, pool="AVE", kernel_size=5, stride=3)
+    setattr(n, f"{prefix}/ave_pool", pool)
+    c = L.Convolution(pool, num_output=128, kernel_size=1,
+                      weight_filler=dict(type="xavier"),
+                      bias_filler=dict(type="constant", value=0.2),
+                      param=[dict(lr_mult=1, decay_mult=1),
+                             dict(lr_mult=2, decay_mult=0)])
+    setattr(n, f"{prefix}/conv", c)
+    setattr(n, f"{prefix}/relu_conv", L.ReLU(c, in_place=True))
+    fc = L.InnerProduct(c, num_output=1024,
+                        weight_filler=dict(type="xavier"),
+                        bias_filler=dict(type="constant", value=0.2),
+                        param=[dict(lr_mult=1, decay_mult=1),
+                               dict(lr_mult=2, decay_mult=0)])
+    setattr(n, f"{prefix}/fc", fc)
+    setattr(n, f"{prefix}/relu_fc", L.ReLU(fc, in_place=True))
+    setattr(n, f"{prefix}/drop_fc", L.Dropout(fc, dropout_ratio=0.7,
+                                              in_place=True))
+    cls = L.InnerProduct(fc, num_output=1000,
+                         weight_filler=dict(type="xavier"),
+                         bias_filler=dict(type="constant"),
+                         param=[dict(lr_mult=1, decay_mult=1),
+                                dict(lr_mult=2, decay_mult=0)])
+    setattr(n, f"{prefix}/classifier", cls)
+    setattr(n, f"{prefix}/loss", L.SoftmaxWithLoss(
+        cls, label, loss_weight=0.3, include=dict(phase="TRAIN")))
+    setattr(n, f"{prefix}/top-1", L.Accuracy(cls, label,
+                                             include=dict(phase="TEST")))
+    setattr(n, f"{prefix}/top-5", L.Accuracy(cls, label, top_k=5,
+                                             include=dict(phase="TEST")))
+
+
 def googlenet(batch=128):
-    """bvlc_googlenet topology (reference models/bvlc_googlenet), without
-    the aux classifier heads (NVCaffe's training recipe also drops them
-    for large-batch runs)."""
+    """bvlc_googlenet (reference models/bvlc_googlenet/train_val.prototxt):
+    9 inception modules, loss1/loss2 aux heads at weight 0.3, reference
+    layer names throughout."""
     n = NetSpec("GoogLeNet")
     n.data, n.label = L.Input(ntop=2, input_param=dict(
         shape=[dict(dim=[batch, 3, 224, 224]), dict(dim=[batch])]))
-    n.conv1, n.conv1_relu = conv_relu(n.data, 64, 7, stride=2, pad=3)
-    n.pool1 = L.Pooling(n.conv1_relu, pool="MAX", kernel_size=3, stride=2)
-    n.norm1 = L.LRN(n.pool1, local_size=5, alpha=1e-4, beta=0.75)
-    n.conv2_reduce, n.conv2_reduce_relu = conv_relu(n.norm1, 64, 1)
-    n.conv2, n.conv2_relu = conv_relu(n.conv2_reduce_relu, 192, 3, pad=1)
-    n.norm2 = L.LRN(n.conv2_relu, local_size=5, alpha=1e-4, beta=0.75)
-    n.pool2 = L.Pooling(n.norm2, pool="MAX", kernel_size=3, stride=2)
-    x = inception(n, "inception_3a", n.pool2, 64, 96, 128, 16, 32, 32)
+
+    def cr(name, b, nout, ks, stride=1, pad=0):
+        c = L.Convolution(b, num_output=nout, kernel_size=ks, stride=stride,
+                          pad=pad, weight_filler=dict(type="xavier"),
+                          bias_filler=dict(type="constant", value=0.2),
+                          param=[dict(lr_mult=1, decay_mult=1),
+                                 dict(lr_mult=2, decay_mult=0)])
+        r = L.ReLU(c, in_place=True)
+        setattr(n, name, c)
+        setattr(n, f"{name.rsplit('/', 1)[0]}/relu_{name.rsplit('/', 1)[1]}", r)
+        return r
+
+    x = cr("conv1/7x7_s2", n.data, 64, 7, stride=2, pad=3)
+    setattr(n, "pool1/3x3_s2", L.Pooling(x, pool="MAX", kernel_size=3, stride=2))
+    setattr(n, "pool1/norm1", L.LRN(getattr(n, "pool1/3x3_s2"),
+                                    local_size=5, alpha=1e-4, beta=0.75))
+    x = cr("conv2/3x3_reduce", getattr(n, "pool1/norm1"), 64, 1)
+    x = cr("conv2/3x3", x, 192, 3, pad=1)
+    setattr(n, "conv2/norm2", L.LRN(x, local_size=5, alpha=1e-4, beta=0.75))
+    setattr(n, "pool2/3x3_s2", L.Pooling(getattr(n, "conv2/norm2"),
+                                         pool="MAX", kernel_size=3, stride=2))
+    x = inception(n, "inception_3a", getattr(n, "pool2/3x3_s2"),
+                  64, 96, 128, 16, 32, 32)
     x = inception(n, "inception_3b", x, 128, 128, 192, 32, 96, 64)
-    n.pool3 = L.Pooling(x, pool="MAX", kernel_size=3, stride=2)
-    x = inception(n, "inception_4a", n.pool3, 192, 96, 208, 16, 48, 64)
+    setattr(n, "pool3/3x3_s2", L.Pooling(x, pool="MAX", kernel_size=3, stride=2))
+    x = inception(n, "inception_4a", getattr(n, "pool3/3x3_s2"),
+                  192, 96, 208, 16, 48, 64)
+    _googlenet_aux(n, "loss1", x, n.label)
     x = inception(n, "inception_4b", x, 160, 112, 224, 24, 64, 64)
     x = inception(n, "inception_4c", x, 128, 128, 256, 24, 64, 64)
     x = inception(n, "inception_4d", x, 112, 144, 288, 32, 64, 64)
+    _googlenet_aux(n, "loss2", x, n.label)
     x = inception(n, "inception_4e", x, 256, 160, 320, 32, 128, 128)
-    n.pool4 = L.Pooling(x, pool="MAX", kernel_size=3, stride=2)
-    x = inception(n, "inception_5a", n.pool4, 256, 160, 320, 32, 128, 128)
+    setattr(n, "pool4/3x3_s2", L.Pooling(x, pool="MAX", kernel_size=3, stride=2))
+    x = inception(n, "inception_5a", getattr(n, "pool4/3x3_s2"),
+                  256, 160, 320, 32, 128, 128)
     x = inception(n, "inception_5b", x, 384, 192, 384, 48, 128, 128)
-    n.pool5 = L.Pooling(x, pool="AVE", global_pooling=True)
-    n.drop5 = L.Dropout(n.pool5, dropout_ratio=0.4, in_place=True)
-    n.loss3_classifier = L.InnerProduct(
-        n.pool5, num_output=1000, weight_filler=dict(type="xavier"),
-        bias_filler=dict(type="constant"),
-        param=[dict(lr_mult=1, decay_mult=1), dict(lr_mult=2, decay_mult=0)])
-    train_test_tail(n, n.loss3_classifier)
+    setattr(n, "pool5/7x7_s1", L.Pooling(x, pool="AVE", kernel_size=7, stride=1))
+    setattr(n, "pool5/drop_7x7_s1", L.Dropout(getattr(n, "pool5/7x7_s1"),
+                                              dropout_ratio=0.4, in_place=True))
+    cls = L.InnerProduct(getattr(n, "pool5/7x7_s1"), num_output=1000,
+                         weight_filler=dict(type="xavier"),
+                         bias_filler=dict(type="constant"),
+                         param=[dict(lr_mult=1, decay_mult=1),
+                                dict(lr_mult=2, decay_mult=0)])
+    setattr(n, "loss3/classifier", cls)
+    setattr(n, "loss3/loss3", L.SoftmaxWithLoss(
+        cls, n.label, include=dict(phase="TRAIN")))
+    setattr(n, "loss3/top-1", L.Accuracy(cls, n.label,
+                                         include=dict(phase="TEST")))
+    setattr(n, "loss3/top-5", L.Accuracy(cls, n.label, top_k=5,
+                                         include=dict(phase="TEST")))
     return n
 
 

@@ -1,0 +1,53 @@
+"""Model-zoo parity tests: generated nets must match the reference zoo's
+weight-bearing layers BY NAME and output-channel count (so reference
+.caffemodel files load layer-for-layer). Skipped when the reference tree
+is not mounted."""
+
+import os
+
+import pytest
+
+from caffe_mpi_tpu.proto import NetParameter, normalize_net
+
+REF = "/root/reference/models"
+
+CASES = [
+    ("googlenet", f"{REF}/bvlc_googlenet/train_val.prototxt"),
+    ("inception_v3", f"{REF}/inception_v3/train_val.prototxt"),
+]
+
+
+def weight_layers(net):
+    out = {}
+    for lp in net.layer:
+        if lp.type == "Convolution":
+            out[lp.name] = ("conv", lp.convolution_param.num_output)
+        elif lp.type == "InnerProduct":
+            out[lp.name] = ("ip", lp.inner_product_param.num_output)
+    return out
+
+
+@pytest.mark.parametrize("ours,ref_path", CASES, ids=[c[0] for c in CASES])
+def test_weight_layer_parity(ours, ref_path):
+    if not os.path.exists(ref_path):
+        pytest.skip("reference not mounted")
+    our_net = normalize_net(
+        NetParameter.from_file(f"models/{ours}/train_val.prototxt"))
+    ref_net = normalize_net(NetParameter.from_file(ref_path))
+    ours_w = weight_layers(our_net)
+    ref_w = weight_layers(ref_net)
+    missing = set(ref_w) - set(ours_w)
+    extra = set(ours_w) - set(ref_w)
+    changed = {k: (ref_w[k], ours_w[k])
+               for k in set(ref_w) & set(ours_w) if ref_w[k] != ours_w[k]}
+    assert not missing, f"missing weight layers: {sorted(missing)[:10]}"
+    assert not extra, f"extra weight layers: {sorted(extra)[:10]}"
+    assert not changed, f"channel mismatches: {changed}"
+
+
+def test_aux_heads_weighted():
+    net = normalize_net(
+        NetParameter.from_file("models/googlenet/train_val.prototxt"))
+    aux = [l for l in net.layer if l.type == "SoftmaxWithLoss"
+           and l.loss_weight == [0.3]]
+    assert len(aux) == 2

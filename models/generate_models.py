@@ -222,53 +222,80 @@ def googlenet(batch=128):
     return n
 
 
-def resnet50(batch=32, bf16=False):
-    """ResNet-50, bottleneck [3,4,6,3], NVCaffe fused-scale BatchNorm
-    (reference models/resnet50/train_val.prototxt)."""
-    n = NetSpec("ResNet50")
+def _resnet(n, batch, stages, bottleneck):
+    """Shared ResNet body emitter with the reference's layer names
+    (res{stage}.{block}.conv{i} / .skipConv / .sum, X/bn, fc — see
+    models/resnet50/train_val.prototxt): fused scale_bias BN, eps 1e-4,
+    msra fillers, stride on the block's first conv."""
     n.data, n.label = L.Input(ntop=2, input_param=dict(
         shape=[dict(dim=[batch, 3, 224, 224]), dict(dim=[batch])]))
 
-    def conv_bn(b, nout, ks, stride=1, pad=0, relu=True):
-        c = L.Convolution(b, num_output=nout, kernel_size=ks, stride=stride,
-                          pad=pad, bias_term=False,
-                          weight_filler=dict(type="msra"),
-                          param=[dict(lr_mult=1, decay_mult=1)])
-        bn = L.BatchNorm(c, scale_bias=True, eps=1e-5,
-                         moving_average_fraction=0.9)
-        if relu:
-            return L.ReLU(bn, in_place=True), bn
-        return bn, bn
+    def cb(name, b, nout, ks, stride=1, pad=0, relu=True):
+        return conv_bn_relu(n, name, b, nout, ks, stride=stride, pad_h=pad,
+                            filler="msra", relu=relu)
 
-    def bottleneck(b, nout, stride, project):
-        if project:
-            sc, _ = conv_bn(b, nout * 4, 1, stride=stride, relu=False)
-        else:
-            sc = b
-        x, _ = conv_bn(b, nout, 1, stride=stride)
-        x, _ = conv_bn(x, nout, 3, pad=1)
-        x, _ = conv_bn(x, nout * 4, 1, relu=False)
-        s = L.Eltwise(sc, x, operation="SUM")
-        return L.ReLU(s, in_place=True)
-
-    x, _ = conv_bn(n.data, 64, 7, stride=2, pad=3)
-    n.conv1 = x
+    x = cb("conv1", n.data, 64, 7, stride=2, pad=3)
     n.pool1 = L.Pooling(x, pool="MAX", kernel_size=3, stride=2)
     x = n.pool1
-    stages = [(64, 3), (128, 4), (256, 6), (512, 3)]
     for si, (nout, blocks) in enumerate(stages):
-        for bi in range(blocks):
-            stride = 2 if (si > 0 and bi == 0) else 1
-            x = bottleneck(x, nout, stride, project=(bi == 0))
-            setattr(n, f"res{si + 2}{chr(ord('a') + bi)}", x)
+        for bi in range(1, blocks + 1):
+            prefix = f"res{si + 2}.{bi}"
+            stride = 2 if (si > 0 and bi == 1) else 1
+            x = bottleneck(n, prefix, x, nout, stride, cb,
+                           project=(bi == 1))
     n.pool5 = L.Pooling(x, pool="AVE", global_pooling=True)
-    n.fc1000 = L.InnerProduct(n.pool5, num_output=1000,
-                              weight_filler=dict(type="msra"),
-                              bias_filler=dict(type="constant"),
-                              param=[dict(lr_mult=1, decay_mult=1),
-                                     dict(lr_mult=2, decay_mult=0)])
-    train_test_tail(n, n.fc1000)
+    n.fc = L.InnerProduct(n.pool5, num_output=1000,
+                          weight_filler=dict(type="msra"),
+                          bias_filler=dict(type="constant"),
+                          param=[dict(lr_mult=1, decay_mult=1),
+                                 dict(lr_mult=2, decay_mult=0)])
+    train_test_tail(n, n.fc)
     return n
+
+
+def _bottleneck50(n, prefix, b, nout, stride, cb, project):
+    if project:
+        sc = cb(f"{prefix}.skipConv", b, nout * 4, 1, stride=stride,
+                relu=False)
+    else:
+        sc = b
+    x = cb(f"{prefix}.conv1", b, nout, 1, stride=stride)
+    x = cb(f"{prefix}.conv2", x, nout, 3, pad=1)
+    x = cb(f"{prefix}.conv3", x, nout * 4, 1, relu=False)
+    s = L.Eltwise(x, sc, operation="SUM")
+    setattr(n, f"{prefix}.sum", s)
+    r = L.ReLU(s, in_place=True)
+    setattr(n, f"{prefix}.relu", r)
+    return r
+
+
+def _basicblock18(n, prefix, b, nout, stride, cb, project):
+    project = project and (stride != 1 or nout != 64)
+    if project:
+        sc = cb(f"{prefix}.skipConv", b, nout, 1, stride=stride, relu=False)
+    else:
+        sc = b
+    x = cb(f"{prefix}.conv1", b, nout, 3, stride=stride, pad=1)
+    x = cb(f"{prefix}.conv2", x, nout, 3, pad=1, relu=False)
+    s = L.Eltwise(x, sc, operation="SUM")
+    setattr(n, f"{prefix}.sum", s)
+    r = L.ReLU(s, in_place=True)
+    setattr(n, f"{prefix}.relu", r)
+    return r
+
+
+def resnet50(batch=32):
+    """ResNet-50 (reference models/resnet50/train_val.prototxt): bottleneck
+    [3,4,6,3] with reference layer names so reference weights load."""
+    return _resnet(NetSpec("ResNet50"), batch,
+                   [(64, 3), (128, 4), (256, 6), (512, 3)], _bottleneck50)
+
+
+def resnet18(batch=64):
+    """ResNet-18 (reference models/resnet18/train_val.prototxt): basic
+    blocks [2,2,2,2], projection only on downsampling stages."""
+    return _resnet(NetSpec("ResNet18"), batch,
+                   [(64, 2), (128, 2), (256, 2), (512, 2)], _basicblock18)
 
 
 def conv_bn_relu(n, name, bottom, nout, kh, kw=None, stride=1, pad_h=0,
@@ -546,48 +573,6 @@ def vgg16(batch=64):
     train_test_tail(n, n.fc8)
     return n
 
-
-def resnet18(batch=64):
-    """ResNet-18: basic blocks [2,2,2,2] (reference models/resnet18)."""
-    n = NetSpec("ResNet18")
-    n.data, n.label = L.Input(ntop=2, input_param=dict(
-        shape=[dict(dim=[batch, 3, 224, 224]), dict(dim=[batch])]))
-
-    def conv_bn(b, nout, ks, stride=1, pad=0, relu=True):
-        c = L.Convolution(b, num_output=nout, kernel_size=ks, stride=stride,
-                          pad=pad, bias_term=False,
-                          weight_filler=dict(type="msra"),
-                          param=[dict(lr_mult=1, decay_mult=1)])
-        bn = L.BatchNorm(c, scale_bias=True, eps=1e-5,
-                         moving_average_fraction=0.9)
-        if relu:
-            return L.ReLU(bn, in_place=True)
-        return bn
-
-    def basic_block(b, nout, stride, project):
-        sc = conv_bn(b, nout, 1, stride=stride, relu=False) if project else b
-        x = conv_bn(b, nout, 3, stride=stride, pad=1)
-        x = conv_bn(x, nout, 3, pad=1, relu=False)
-        return L.ReLU(L.Eltwise(sc, x, operation="SUM"), in_place=True)
-
-    x = conv_bn(n.data, 64, 7, stride=2, pad=3)
-    n.conv1 = x
-    n.pool1 = L.Pooling(x, pool="MAX", kernel_size=3, stride=2)
-    x = n.pool1
-    for si, nout in enumerate([64, 128, 256, 512]):
-        for bi in range(2):
-            stride = 2 if (si > 0 and bi == 0) else 1
-            x = basic_block(x, nout, stride,
-                            project=(bi == 0 and si > 0))
-            setattr(n, f"res{si + 2}{chr(ord('a') + bi)}", x)
-    n.pool5 = L.Pooling(x, pool="AVE", global_pooling=True)
-    n.fc1000 = L.InnerProduct(n.pool5, num_output=1000,
-                              weight_filler=dict(type="msra"),
-                              bias_filler=dict(type="constant"),
-                              param=[dict(lr_mult=1, decay_mult=1),
-                                     dict(lr_mult=2, decay_mult=0)])
-    train_test_tail(n, n.fc1000)
-    return n
 
 
 def cifar10_nv(batch=128):

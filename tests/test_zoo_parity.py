@@ -14,6 +14,8 @@ REF = "/root/reference/models"
 CASES = [
     ("googlenet", f"{REF}/bvlc_googlenet/train_val.prototxt"),
     ("inception_v3", f"{REF}/inception_v3/train_val.prototxt"),
+    ("resnet50", f"{REF}/resnet50/train_val.prototxt"),
+    ("resnet18", f"{REF}/resnet18/train_val.prototxt"),
 ]
 
 

@@ -16,6 +16,9 @@ CASES = [
     ("inception_v3", f"{REF}/inception_v3/train_val.prototxt"),
     ("resnet50", f"{REF}/resnet50/train_val.prototxt"),
     ("resnet18", f"{REF}/resnet18/train_val.prototxt"),
+    ("alexnet", f"{REF}/bvlc_alexnet/train_val.prototxt"),
+    ("caffenet", f"{REF}/bvlc_reference_caffenet/train_val.prototxt"),
+    ("vgg16", f"{REF}/vgg16/train_val.prototxt"),
 ]
 
 

@@ -95,6 +95,9 @@ class LayerFn:
         self.kwargs = dict(kwargs)
         self.ntop = self.kwargs.pop("ntop", 1)
         self.in_place = self.kwargs.pop("in_place", False)
+        # explicit layer name when it must differ from the top blob's name
+        # (e.g. reference vgg16's layer "fc8-5" producing blob "fc8")
+        self.layer_name = self.kwargs.pop("layer_name", None)
         self.tops = [Top(self, i) for i in range(self.ntop)]
         # zero-top layers (Silence, HDF5Output) still need a bindable handle
         self.handle = self.tops[0] if self.tops else Top(self, -1)
@@ -110,7 +113,8 @@ class LayerFn:
             return names[top]
 
         node = PbNode()
-        node.add("name", names.get(self.handle) or autonames.get(self.type_name))
+        node.add("name", self.layer_name or names.get(self.handle)
+                 or autonames.get(self.type_name))
         node.add("type", self.type_name)
         for b in self.bottoms:
             node.add("bottom", resolve(b))

@@ -567,12 +567,11 @@ def vgg16(batch=64):
                            bias_filler=dict(type="constant"))
     n.relu7 = L.ReLU(n.fc7, in_place=True)
     n.drop7 = L.Dropout(n.fc7, dropout_ratio=0.5, in_place=True)
-    # the reference vgg16 names its classifier "fc8-5"
-    fc8 = L.InnerProduct(n.fc7, num_output=1000,
-                         weight_filler=dict(type="gaussian", std=0.01),
-                         bias_filler=dict(type="constant"))
-    setattr(n, "fc8-5", fc8)
-    train_test_tail(n, fc8)
+    # the reference vgg16 names this LAYER "fc8-5" but its top blob "fc8"
+    n.fc8 = L.InnerProduct(n.fc7, num_output=1000, layer_name="fc8-5",
+                           weight_filler=dict(type="gaussian", std=0.01),
+                           bias_filler=dict(type="constant"))
+    train_test_tail(n, n.fc8)
     return n
 
 

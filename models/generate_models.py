@@ -831,6 +831,22 @@ def main():
     with open(os.path.join(d, "deploy.prototxt"), "w") as f:
         f.write(rcnn().to_prototxt() + "\n")
     print("wrote models/rcnn/ (deploy only)")
+
+    # fp16 variants (reference models/resnet50/train_val_fp16.prototxt +
+    # solver_fp16.prototxt): FLOAT16 -> bfloat16 on TPU, f32 master
+    # weights, loss scaling
+    for name in ("resnet50", "alexnet"):
+        d = os.path.join(out_root, name)
+        base = open(os.path.join(d, "train_val.prototxt")).read()
+        with open(os.path.join(d, "train_val_fp16.prototxt"), "w") as f:
+            f.write("default_forward_type: FLOAT16\n"
+                    "default_backward_type: FLOAT16\n"
+                    "global_grad_scale: 1000\n" + base)
+        solver = open(os.path.join(d, "solver.prototxt")).read()
+        with open(os.path.join(d, "solver_fp16.prototxt"), "w") as f:
+            f.write(solver.replace("train_val.prototxt",
+                                   "train_val_fp16.prototxt"))
+        print(f"wrote models/{name}/ fp16 variant")
     for name, spec in nets.items():
         d = os.path.join(out_root, name)
         os.makedirs(d, exist_ok=True)

@@ -811,6 +811,61 @@ snapshot_prefix: "models/resnet50/resnet50"
 }
 
 
+def make_deploy(train_val_path: str, batch: int = 10) -> str:
+    """Derive a deploy net from a train_val file (reference zoo ships
+    deploy.prototxt per model): drop phase-gated loss/accuracy layers and
+    the label input, softmax the final classifier into 'prob'."""
+    from caffe_mpi_tpu.proto import NetParameter, NetState, filter_net, normalize_net
+    from caffe_mpi_tpu.proto.text_format import PbNode, PbEnum
+
+    net = normalize_net(NetParameter.from_file(train_val_path))
+    # keep only layers live in NEITHER-specific deploy sense: drop anything
+    # phase-gated (losses, accuracies) and any loss-typed layer
+    drop_types = {"SoftmaxWithLoss", "Accuracy", "EuclideanLoss", "HingeLoss",
+                  "SigmoidCrossEntropyLoss", "ContrastiveLoss", "InfogainLoss",
+                  "MultinomialLogisticLoss", "L1Loss"}
+    kept = [lp for lp in net.layer
+            if lp.type not in drop_types and not lp.include and not lp.exclude]
+    consumed = {b for lp in kept for b in lp.bottom}
+    produced = [t for lp in kept for t in lp.top]
+    # classifier blob = last produced blob not consumed elsewhere
+    final = [t for t in produced if t not in consumed][-1]
+    # dead-branch elimination by reverse liveness (robust to in-place
+    # relu/dropout self-loops): keep only layers reaching the classifier —
+    # the reference deploy files likewise omit the aux branches
+    live = {final}
+    kept_rev = []
+    for lp in reversed(kept):
+        if lp.type == "Input" or any(t in live for t in lp.top):
+            kept_rev.append(lp)
+            live.update(lp.bottom)
+    kept = list(reversed(kept_rev))
+
+    root = PbNode()
+    root.add("name", net.name)
+    for lp in kept:
+        node = lp.to_node()
+        if lp.type == "Input":
+            # single data input at deploy batch size
+            node.fields.pop("top", None)
+            node.add("top", "data")
+            ip = PbNode()
+            shape = PbNode()
+            dims = lp.input_param.shape[0].dim
+            for d in [batch] + [int(x) for x in dims[1:]]:
+                shape.add("dim", d)
+            ip.add("shape", shape)
+            node.fields["input_param"] = [ip]
+        root.add("layer", node)
+    prob = PbNode()
+    prob.add("name", "prob")
+    prob.add("type", "Softmax")
+    prob.add("bottom", final)
+    prob.add("top", "prob")
+    root.add("layer", prob)
+    return root.to_text()
+
+
 def main():
     out_root = os.path.dirname(os.path.abspath(__file__))
     nets = {
@@ -850,10 +905,13 @@ def main():
     for name, spec in nets.items():
         d = os.path.join(out_root, name)
         os.makedirs(d, exist_ok=True)
-        with open(os.path.join(d, "train_val.prototxt"), "w") as f:
+        tv = os.path.join(d, "train_val.prototxt")
+        with open(tv, "w") as f:
             f.write(spec.to_prototxt() + "\n")
         with open(os.path.join(d, "solver.prototxt"), "w") as f:
             f.write(SOLVERS[name])
+        with open(os.path.join(d, "deploy.prototxt"), "w") as f:
+            f.write(make_deploy(tv) + "\n")
         print(f"wrote models/{name}/")
 
 

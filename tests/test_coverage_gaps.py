@@ -1,0 +1,136 @@
+"""Tests for previously-uncovered paths: DummyData, MemoryData, ArgMax axis
+mode, debug_info, V1-format caffemodel parsing, HDF5 snapshot format, and
+the generated deploy nets."""
+
+import glob
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from caffe_mpi_tpu.net import Net
+from caffe_mpi_tpu.proto import NetParameter, SolverParameter
+from gradcheck import make_layer
+
+
+class TestDummyData:
+    def test_constant_and_gaussian_fills(self):
+        net = Net(NetParameter.from_text("""
+        layer { name: "d" type: "DummyData" top: "a" top: "b"
+          dummy_data_param {
+            shape { dim: 2 dim: 3 } shape { dim: 2 dim: 3 }
+            data_filler { type: "constant" value: 7 }
+            data_filler { type: "gaussian" std: 1 }
+          } }
+        """))
+        params, state = net.init(jax.random.PRNGKey(0))
+        blobs, _, _ = net.apply(params, state, {}, train=True,
+                                rng=jax.random.PRNGKey(1))
+        np.testing.assert_allclose(np.array(blobs["a"]), 7.0)
+        assert np.array(blobs["b"]).std() > 0.1
+
+    def test_legacy_4d_fields(self):
+        net = Net(NetParameter.from_text("""
+        layer { name: "d" type: "DummyData" top: "x"
+          dummy_data_param { num: 2 channels: 3 height: 4 width: 5 } }
+        """))
+        assert net.blob_shapes["x"] == (2, 3, 4, 5)
+
+
+class TestMemoryData:
+    def test_feed_slot(self, rng):
+        net = Net(NetParameter.from_text("""
+        layer { name: "m" type: "MemoryData" top: "data" top: "label"
+          memory_data_param { batch_size: 4 channels: 2 height: 3 width: 3 } }
+        """))
+        params, state = net.init(jax.random.PRNGKey(0))
+        feeds = {"data": jnp.asarray(rng.randn(4, 2, 3, 3).astype(np.float32)),
+                 "label": jnp.asarray(rng.randint(0, 5, 4))}
+        blobs, _, _ = net.apply(params, state, feeds, train=False)
+        assert blobs["data"].shape == (4, 2, 3, 3)
+
+
+class TestArgMaxAxis:
+    def test_axis_mode(self, rng):
+        layer, params, state = make_layer(
+            'name: "a" type: "ArgMax" bottom: "x" top: "y"\n'
+            'argmax_param { axis: 1 top_k: 2 }', [(2, 5, 3)])
+        x = jnp.asarray(rng.randn(2, 5, 3).astype(np.float32))
+        (y,), _ = layer.apply(params, state, [x], train=False, rng=None)
+        assert y.shape == (2, 2, 3)
+        top1 = np.array(y)[:, 0, :]
+        np.testing.assert_array_equal(top1, np.argmax(np.array(x), axis=1))
+
+
+class TestDebugInfo:
+    def test_smoke(self, rng, capfd):
+        net = Net(NetParameter.from_text("""
+        debug_info: true
+        layer { name: "in" type: "Input" top: "x"
+                input_param { shape { dim: 2 dim: 3 } } }
+        layer { name: "r" type: "ReLU" bottom: "x" top: "y" }
+        """))
+        assert net.debug_info
+        params, state = net.init(jax.random.PRNGKey(0))
+        net.apply(params, state,
+                  {"x": jnp.asarray(rng.randn(2, 3).astype(np.float32))},
+                  train=False)
+        jax.effects_barrier()
+        out = capfd.readouterr()
+        assert "[Forward]" in out.out + out.err
+
+
+class TestV1Caffemodel:
+    def test_v1_layers_field_parses(self):
+        """Binary NetParameter with V1 `layers` (field 2, name=4, blobs=6)."""
+        from caffe_mpi_tpu.io import _tag, _varint, encode_blob, parse_caffemodel
+        blob = encode_blob(np.arange(6, dtype=np.float32).reshape(2, 3))
+        inner = (_tag(4, 2) + _varint(len(b"old_ip")) + b"old_ip"
+                 + _tag(6, 2) + _varint(len(blob)) + blob)
+        buf = _tag(2, 2) + _varint(len(inner)) + inner
+        weights = parse_caffemodel(buf)
+        assert "old_ip" in weights
+        np.testing.assert_array_equal(weights["old_ip"][0],
+                                      np.arange(6).reshape(2, 3))
+
+
+class TestHDF5Snapshot:
+    def test_snapshot_format_hdf5(self, tmp_path, rng):
+        sp = SolverParameter.from_text(
+            'base_lr: 0.05 lr_policy: "fixed" max_iter: 3 type: "SGD" '
+            'snapshot_format: HDF5')
+        sp.snapshot_prefix = str(tmp_path / "h5snap")
+        sp.net_param = NetParameter.from_text("""
+        layer { name: "in" type: "Input" top: "x" top: "t"
+                input_param { shape { dim: 2 dim: 4 } shape { dim: 2 } } }
+        layer { name: "ip" type: "InnerProduct" bottom: "x" top: "y"
+                inner_product_param { num_output: 3
+                  weight_filler { type: "xavier" } } }
+        layer { name: "l" type: "SoftmaxWithLoss" bottom: "y" bottom: "t"
+                top: "loss" }
+        """)
+        from caffe_mpi_tpu.solver import Solver
+        s = Solver(sp)
+        feeds = {"x": jnp.asarray(rng.randn(2, 4).astype(np.float32)),
+                 "t": jnp.asarray(rng.randint(0, 3, 2))}
+        s.step(2, lambda it: feeds)
+        path = s.snapshot()
+        assert (tmp_path / "h5snap_iter_2.caffemodel.h5").exists()
+        s2 = Solver(sp)
+        s2.restore(path)
+        np.testing.assert_array_equal(np.array(s2.params["ip"]["weight"]),
+                                      np.array(s.params["ip"]["weight"]))
+
+
+class TestDeployNets:
+    def test_all_deploys_build(self):
+        paths = sorted(glob.glob("models/*/deploy.prototxt"))
+        if not paths:
+            pytest.skip("zoo not generated")
+        for path in paths:
+            net = Net(NetParameter.from_file(path), phase="TEST")
+            name = path.split(os.sep)[1]
+            if name != "rcnn":
+                assert "prob" in net.blob_shapes, path

@@ -120,3 +120,37 @@ class MeshPlan:
         """Broadcast params/state to every device (the reference's startup
         ncclBcast of all weights, parallel.cpp:208-227)."""
         return jax.device_put(tree, self.replicated())
+
+    # -- tensor parallelism (beyond the reference's DP-only surface) ----
+    def param_sharding_rules(self, rules: dict[str, tuple]):
+        """Declare per-layer weight shardings over the 'model' axis.
+
+        rules: {layer_name: partition_spec_tuple | "rows"}, e.g.
+          {"fc6": ("model", None)} (or the "rows" shorthand) shards fc6's
+          weight dim 0 (output features) over 'model'. Returns a placement
+          function for param pytrees.
+
+        With params sharded and activations batch-sharded, XLA's GSPMD
+        partitioner inserts the all-gather/reduce-scatter pattern of
+        Megatron-style tensor parallelism automatically — the 'model' mesh
+        axis becomes an intra-layer parallel domain while 'data' stays the
+        gradient-averaging domain."""
+        def place(params):
+            out = {}
+            for lname, lparams in params.items():
+                rule = rules.get(lname)
+                placed = {}
+                for pname, arr in lparams.items():
+                    if rule is not None and pname == "weight":
+                        if rule == "rows":
+                            spec = ["model"] + [None] * (arr.ndim - 1)
+                        else:
+                            spec = list(rule)[:arr.ndim]
+                            spec += [None] * (arr.ndim - len(spec))
+                        placed[pname] = jax.device_put(
+                            arr, NamedSharding(self.mesh, P(*spec)))
+                    else:
+                        placed[pname] = jax.device_put(arr, self.replicated())
+                out[lname] = placed
+            return out
+        return place

@@ -65,7 +65,8 @@ def _load_net_param(sp: SolverParameter, phase: str, model_dir: str = "",
 class Solver:
     def __init__(self, sp: SolverParameter, *, model_dir: str = "",
                  batch_divisor: int = 1, grad_transform=None,
-                 data_shape_probe=None, rank: int = 0, mesh=None):
+                 data_shape_probe=None, rank: int = 0, mesh=None,
+                 param_shardings=None):
         """grad_transform: hook applied to the grad pytree inside the jitted
         step — a custom distributed layer can pass lambda g: psum(g)/n here,
         playing the role of the reference's P2PSync::allreduce callback.
@@ -73,7 +74,11 @@ class Solver:
         mesh: a parallel.MeshPlan. When set, training runs SPMD over the
         mesh: params/opt state replicated, feed batches sharded over the
         'data' axis, XLA inserting and overlapping the gradient all-reduce
-        (the whole reference parallel.cpp machinery)."""
+        (the whole reference parallel.cpp machinery).
+
+        param_shardings: optional {layer_name: spec} tensor-parallel rules
+        (see MeshPlan.param_sharding_rules) — sharded layers' weights live
+        split over the 'model' axis and GSPMD partitions their matmuls."""
         self.sp = sp
         self.type = solver_type(sp)
         if self.type not in UPDATE_FNS:
@@ -107,10 +112,21 @@ class Solver:
         self.opt_state = self._init_opt_state()
         self.mesh = mesh
         if mesh is not None:
-            # startup weight broadcast (reference parallel.cpp:208-227)
-            self.params = mesh.replicate(self.params)
+            # startup weight broadcast (reference parallel.cpp:208-227) —
+            # replicated by default, or tensor-parallel-sharded per rules
             self.net_state = mesh.replicate(self.net_state)
-            self.opt_state = mesh.replicate(self.opt_state)
+            if param_shardings:
+                self.params = mesh.param_sharding_rules(param_shardings)(
+                    self.params)
+                self.opt_state = {
+                    ln: {pn: tuple(
+                        jax.device_put(s, self.params[ln][pn].sharding)
+                        for s in slots)
+                        for pn, slots in lo.items()}
+                    for ln, lo in self.opt_state.items()}
+            else:
+                self.params = mesh.replicate(self.params)
+                self.opt_state = mesh.replicate(self.opt_state)
         self.iter = 0
         self._loss_window = deque(maxlen=max(sp.average_loss, 1))
         self._step_jit = None

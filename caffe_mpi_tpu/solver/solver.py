@@ -111,22 +111,19 @@ class Solver:
         self.params, self.net_state = self.net.init(self.base_rng)
         self.opt_state = self._init_opt_state()
         self.mesh = mesh
+        self._param_shardings = param_shardings
+        if param_shardings and mesh is None:
+            raise ValueError("param_shardings requires a mesh")
+        if param_shardings:
+            unknown = set(param_shardings) - set(self.params)
+            if unknown:
+                raise ValueError(
+                    f"param_shardings for unknown layers: {sorted(unknown)}")
         if mesh is not None:
             # startup weight broadcast (reference parallel.cpp:208-227) —
             # replicated by default, or tensor-parallel-sharded per rules
             self.net_state = mesh.replicate(self.net_state)
-            if param_shardings:
-                self.params = mesh.param_sharding_rules(param_shardings)(
-                    self.params)
-                self.opt_state = {
-                    ln: {pn: tuple(
-                        jax.device_put(s, self.params[ln][pn].sharding)
-                        for s in slots)
-                        for pn, slots in lo.items()}
-                    for ln, lo in self.opt_state.items()}
-            else:
-                self.params = mesh.replicate(self.params)
-                self.opt_state = mesh.replicate(self.opt_state)
+            self._place_params_opt()
         self.iter = 0
         self._loss_window = deque(maxlen=max(sp.average_loss, 1))
         self._step_jit = None
@@ -138,6 +135,25 @@ class Solver:
                  if l2 == ln}
             for ln in {l for (l, _, _) in self.net.learnable_param_decls()}
         }
+
+    def _place_params_opt(self) -> None:
+        """(Re)apply mesh placement to params + optimizer slots — used at
+        init and after restore/load_weights so TP shardings survive."""
+        mesh = self.mesh
+        if mesh is None:
+            return
+        if self._param_shardings:
+            self.params = mesh.param_sharding_rules(self._param_shardings)(
+                self.params)
+            self.opt_state = {
+                ln: {pn: tuple(
+                    jax.device_put(s, self.params[ln][pn].sharding)
+                    for s in slots)
+                    for pn, slots in lo.items()}
+                for ln, lo in self.opt_state.items()}
+        else:
+            self.params = mesh.replicate(self.params)
+            self.opt_state = mesh.replicate(self.opt_state)
 
     # ------------------------------------------------------------------
     def _init_opt_state(self):
@@ -396,8 +412,7 @@ class Solver:
                 slots = list(self.opt_state[lname][pname])
                 slots[int(si)] = jnp.asarray(data[key])
                 self.opt_state[lname][pname] = tuple(slots)
-        if self.mesh is not None:
-            self.opt_state = self.mesh.replicate(self.opt_state)
+        self._place_params_opt()
         log.info("Restored solver state from %s (iter %d)", path, self.iter)
 
     def load_weights(self, path: str) -> None:
@@ -407,6 +422,6 @@ class Solver:
         self.params, self.net_state = self.net.import_weights(
             self.params, self.net_state, weights)
         if self.mesh is not None:
-            self.params = self.mesh.replicate(self.params)
             self.net_state = self.mesh.replicate(self.net_state)
+        self._place_params_opt()
         log.info("Loaded weights from %s (%d layers)", path, len(weights))

@@ -139,6 +139,32 @@ class TestDataParallelTraining:
         # sharding preserved through donated updates
         assert not s_tp.params["ip1"]["weight"].sharding.is_fully_replicated
 
+    def test_tp_sharding_survives_restore(self, tmp_path):
+        data = batches(4)
+        sp = SolverParameter.from_text(
+            'base_lr: 0.05 lr_policy: "fixed" max_iter: 4 type: "SGD" '
+            'random_seed: 7')
+        sp.snapshot_prefix = str(tmp_path / "tp")
+        sp.net_param = NetParameter.from_text(NET)
+        mesh = MeshPlan.from_shape(data=2, model=4)
+        s = Solver(sp, mesh=mesh, param_shardings={"ip1": ("model", None)})
+        s.step(2, lambda it: data[it % 4])
+        path = s.snapshot()
+        s.restore(path)
+        assert not s.params["ip1"]["weight"].sharding.is_fully_replicated
+        assert not s.opt_state["ip1"]["weight"][0].sharding.is_fully_replicated
+        s.step(1, lambda it: data[it % 4])  # still trains after restore
+
+    def test_tp_misuse_raises(self):
+        sp = SolverParameter.from_text(
+            'base_lr: 0.05 lr_policy: "fixed" max_iter: 1 type: "SGD"')
+        sp.net_param = NetParameter.from_text(NET)
+        with pytest.raises(ValueError, match="requires a mesh"):
+            Solver(sp, param_shardings={"ip1": ("model", None)})
+        with pytest.raises(ValueError, match="unknown layers"):
+            Solver(sp, mesh=MeshPlan.data_parallel(),
+                   param_shardings={"nope": ("model", None)})
+
     def test_grad_transform_hook(self):
         """Custom allreduce hook (the P2PSync::allreduce analogue)."""
         calls = []

@@ -156,6 +156,40 @@ class TestLRPolicies:
         assert float(momentum(p, jnp.int32(50))) == pytest.approx(0.7)
 
 
+class TestTestInterval:
+    def test_test_all_during_training(self, rng):
+        """test_interval evaluation with train->test weight sharing and
+        score averaging (reference solver.cpp:439-540)."""
+        from caffe_mpi_tpu.proto.config import NetParameter
+        net_text = """
+        layer { name: "in" type: "Input" top: "x" top: "t"
+                input_param { shape { dim: 8 dim: 6 } shape { dim: 8 } } }
+        layer { name: "ip" type: "InnerProduct" bottom: "x" top: "y"
+                inner_product_param { num_output: 3
+                  weight_filler { type: "xavier" } } }
+        layer { name: "loss" type: "SoftmaxWithLoss" bottom: "y" bottom: "t"
+                top: "l" include { phase: TRAIN } }
+        layer { name: "acc" type: "Accuracy" bottom: "y" bottom: "t"
+                top: "acc" include { phase: TEST } }
+        """
+        sp = SolverParameter.from_text(
+            'base_lr: 0.2 lr_policy: "fixed" max_iter: 40 type: "SGD" '
+            'test_interval: 20 test_iter: 4 test_initialization: false')
+        sp.net_param = NetParameter.from_text(net_text)
+        solver = Solver(sp)
+        templates = rng.randn(3, 6).astype(np.float32)
+
+        def feed(it):
+            r = np.random.RandomState(it)
+            t = r.randint(0, 3, 8)
+            return {"x": jnp.asarray(templates[t] + 0.1 * r.randn(8, 6).astype(np.float32)),
+                    "t": jnp.asarray(t)}
+
+        solver.step(40, feed, test_feed_fns=[lambda k: feed(5000 + k)])
+        scores = solver.test_all([lambda k: feed(9000 + k)])
+        assert scores[0]["acc"] > 0.9
+
+
 class TestEndToEnd:
     def test_lsq_converges(self, rng):
         solver = make_solver('type: "SGD" momentum: 0.9 base_lr: 0.02')

@@ -62,8 +62,10 @@ class LossBase(Layer):
         p = self.lp.loss_param
         if p is None:
             return "VALID"
+        # legacy flag (softmax_loss_layer.cpp:35-38): normalize:false means
+        # BATCH_SIZE, normalize:true (or absent) means the modern default
         if not p.has("normalization") and p.has("normalize") and not p.normalize:
-            return "BATCH_SIZE" if isinstance(self, EuclideanLossLayer) else "NONE"
+            return "BATCH_SIZE"
         return p.normalization
 
     def _ignore_label(self):

@@ -123,8 +123,9 @@ class PoolingLayer(Layer):
             self.kernel = (kh, kw)
             self.stride = (p.stride_h or p.stride, p.stride_w or p.stride)
             self.pad = (p.pad_h or p.pad, p.pad_w or p.pad)
-        oh = pool_output_dim(h, self.kernel[0], self.pad[0], self.stride[0])
-        ow = pool_output_dim(w, self.kernel[1], self.pad[1], self.stride[1])
+        any_pad = self.pad[0] > 0 or self.pad[1] > 0
+        oh = pool_output_dim(h, self.kernel[0], self.pad[0], self.stride[0], any_pad)
+        ow = pool_output_dim(w, self.kernel[1], self.pad[1], self.stride[1], any_pad)
         self.method = str(p.pool).upper()
         if self.method == "STOCHASTIC" and (self.pad[0] or self.pad[1]):
             raise ValueError("STOCHASTIC pooling does not support padding "

@@ -346,8 +346,10 @@ class Net:
             for (kind, pname), blob in zip(spec, blobs):
                 if kind == "correction":
                     c = float(np.asarray(blob).reshape(-1)[0])
-                    # BVLC stores mean/var pre-scaled by the correction
-                    correction = (1.0 / c) if c not in (0.0, 1.0) else 1.0
+                    # BVLC stores mean/var pre-scaled by the correction;
+                    # scale_factor = (c == 0 ? 0 : 1/c) — a zero correction
+                    # zeroes the running stats (batch_norm_layer.cpp)
+                    correction = 0.0 if c == 0.0 else (1.0 / c)
             for (kind, pname), blob in zip(spec, blobs):
                 blob = np.asarray(blob, np.float32)
                 if kind == "param":

@@ -24,9 +24,15 @@ import numpy as np
 from jax import lax
 
 
-def pool_output_dim(size: int, kernel: int, pad: int, stride: int) -> int:
+def pool_output_dim(size: int, kernel: int, pad: int, stride: int,
+                    any_pad: bool | None = None) -> int:
+    """One output dimension. `any_pad` mirrors the reference's
+    `if (pad_h_ || pad_w_)` guard (pooling_layer.cpp:96-108): the last-window
+    clip applies to BOTH dims whenever EITHER pad is nonzero."""
     out = int(math.ceil((size + 2 * pad - kernel) / stride)) + 1
-    if pad > 0 and (out - 1) * stride >= size + pad:
+    if any_pad is None:
+        any_pad = pad > 0
+    if any_pad and (out - 1) * stride >= size + pad:
         out -= 1
     return out
 
@@ -41,8 +47,9 @@ def max_pool2d(x: jnp.ndarray, kernel: tuple[int, int], stride: tuple[int, int],
                pad: tuple[int, int]) -> jnp.ndarray:
     """NCHW max pooling, Caffe ceil-mode output size."""
     n, c, h, w = x.shape
-    oh = pool_output_dim(h, kernel[0], pad[0], stride[0])
-    ow = pool_output_dim(w, kernel[1], pad[1], stride[1])
+    any_pad = pad[0] > 0 or pad[1] > 0
+    oh = pool_output_dim(h, kernel[0], pad[0], stride[0], any_pad)
+    ow = pool_output_dim(w, kernel[1], pad[1], stride[1], any_pad)
     ph = _pad_amounts(h, kernel[0], pad[0], stride[0], oh)
     pw = _pad_amounts(w, kernel[1], pad[1], stride[1], ow)
     neg_inf = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
@@ -58,8 +65,9 @@ def avg_pool2d(x: jnp.ndarray, kernel: tuple[int, int], stride: tuple[int, int],
                pad: tuple[int, int]) -> jnp.ndarray:
     """NCHW average pooling with Caffe's padded-window divisor."""
     n, c, h, w = x.shape
-    oh = pool_output_dim(h, kernel[0], pad[0], stride[0])
-    ow = pool_output_dim(w, kernel[1], pad[1], stride[1])
+    any_pad = pad[0] > 0 or pad[1] > 0
+    oh = pool_output_dim(h, kernel[0], pad[0], stride[0], any_pad)
+    ow = pool_output_dim(w, kernel[1], pad[1], stride[1], any_pad)
     ph = _pad_amounts(h, kernel[0], pad[0], stride[0], oh)
     pw = _pad_amounts(w, kernel[1], pad[1], stride[1], ow)
     # init must be a CONCRETE scalar: a traced jnp scalar becomes an unknown

@@ -181,6 +181,37 @@ class TestPooling:
         np.testing.assert_allclose(np.array(y)[:, :, 0, 0],
                                    np.array(x).mean(axis=(2, 3)), rtol=1e-5)
 
+    def test_output_dim_clip_guard_matches_reference(self):
+        """The last-window clip applies to BOTH dims whenever EITHER pad is
+        nonzero — the reference's `if (pad_h_ || pad_w_)` guard
+        (pooling_layer.cpp:96-108), not a per-dim pad check."""
+        import math
+        from caffe_mpi_tpu.ops.pool import pool_output_dim
+
+        def ref_dims(h, w, k, s, ph, pw):
+            oh = int(math.ceil((h + 2 * ph - k) / s)) + 1
+            ow = int(math.ceil((w + 2 * pw - k) / s)) + 1
+            if ph or pw:
+                if (oh - 1) * s >= h + ph:
+                    oh -= 1
+                if (ow - 1) * s >= w + pw:
+                    ow -= 1
+            return oh, ow
+
+        for h in (3, 4, 6, 7):
+            for w in (3, 5, 6):
+                for k in (1, 2, 3):
+                    for s in (1, 2, 3):
+                        for ph in (0, 1):
+                            for pw in (0, 1):
+                                if ph >= k or pw >= k:
+                                    continue  # Caffe CHECKs pad < kernel
+                                any_pad = ph > 0 or pw > 0
+                                got = (pool_output_dim(h, k, ph, s, any_pad),
+                                       pool_output_dim(w, k, pw, s, any_pad))
+                                assert got == ref_dims(h, w, k, s, ph, pw), \
+                                    (h, w, k, s, ph, pw)
+
     def test_gradients(self, rng):
         for pool in ("MAX", "AVE"):
             layer, params, state = make_layer(
@@ -375,6 +406,33 @@ class TestLosses:
                               torch.tensor(t, dtype=torch.long),
                               ignore_index=255)
         np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+
+    def test_legacy_normalize_false_is_batch_size(self, rng):
+        """loss_param { normalize: false } maps to BATCH_SIZE for every
+        loss (softmax_loss_layer.cpp:35-38), i.e. divide by N even when the
+        target is spatial — NOT by the full count, NOT by 1."""
+        x = rand((3, 4, 2, 2), rng)
+        t = jnp.asarray(rng.randint(0, 4, (3, 2, 2)))
+        legacy, params, state = make_layer(
+            'name: "l" type: "SoftmaxWithLoss" bottom: "x" bottom: "t" top: "loss"\n'
+            'loss_param { normalize: false }',
+            [(3, 4, 2, 2), (3,)],
+        )
+        (loss,), _ = legacy.apply(params, state, [x, t], train=True, rng=None)
+        modern, p2, s2 = make_layer(
+            'name: "l" type: "SoftmaxWithLoss" bottom: "x" bottom: "t" top: "loss"\n'
+            'loss_param { normalization: BATCH_SIZE }',
+            [(3, 4, 2, 2), (3,)],
+        )
+        (ref,), _ = modern.apply(p2, s2, [x, t], train=True, rng=None)
+        np.testing.assert_allclose(float(loss), float(ref), rtol=1e-6)
+        # sanity: BATCH_SIZE (sum/3) differs from VALID (sum/12) here
+        valid, p3, s3 = make_layer(
+            'name: "l" type: "SoftmaxWithLoss" bottom: "x" bottom: "t" top: "loss"',
+            [(3, 4, 2, 2), (3,)],
+        )
+        (lv,), _ = valid.apply(p3, s3, [x, t], train=True, rng=None)
+        np.testing.assert_allclose(float(loss), 4 * float(lv), rtol=1e-5)
 
     def test_softmax_loss_gradients(self, rng):
         layer, params, state = make_layer(

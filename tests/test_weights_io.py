@@ -80,6 +80,21 @@ class TestWeightRoundTrip:
         np.testing.assert_allclose(np.array(state2["bn1"]["mean"]), 2.0)
         np.testing.assert_allclose(np.array(state2["bn1"]["var"]), 3.0)
 
+    def test_zero_correction_zeroes_stats(self):
+        """blobs[2] == 0 (never-trained BVLC model) means scale_factor = 0:
+        the stored mean/var garbage is ZEROED on import, not kept
+        (batch_norm_layer.cpp scale_factor = blobs[2]==0 ? 0 : 1/blobs[2])."""
+        net, params, state = build()
+        weights = {
+            "bn1": [np.full(4, 7.5, np.float32),   # garbage accumulators
+                    np.full(4, -3.0, np.float32),
+                    np.zeros(1, np.float32),       # zero correction
+                    np.ones(4, np.float32), np.zeros(4, np.float32)],
+        }
+        _, state2 = net.import_weights(params, state, weights)
+        np.testing.assert_array_equal(np.array(state2["bn1"]["mean"]), 0.0)
+        np.testing.assert_array_equal(np.array(state2["bn1"]["var"]), 0.0)
+
     def test_unmatched_layers_keep_init(self):
         net, params, state = build()
         w0 = np.array(params["conv1"]["weight"])

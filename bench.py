@@ -9,84 +9,174 @@ Baseline: the reference's only published absolute throughput — CaffeNet,
 20 iterations x 256 images in 19.2 s with cuDNN on a Tesla K40
 (docs/performance_hardware.md:17-24) = 266.7 img/s; the 16-GPU results are
 speedups over this class of single-GPU run (BASELINE.md).
-vs_baseline = ours / 266.7.
+vs_baseline = ours / 266.7. Also reports MFU: analytic fwd+bwd model FLOPs
+(caffe_mpi_tpu/utils/flops.py) over measured step time and chip peak.
 
 The full training step — forward, backward, SGD+momentum update — runs as
 one jit-compiled XLA program, the same path `caffe train` uses.
+
+Failure containment (the TPU here sits behind a flaky tunnel, and a dead
+tunnel HANGS inside C++ device calls, where no Python signal handler can
+run): ALL device work happens in watched subprocesses — a cheap probe
+first, then the bench body — each with a hard subprocess timeout. The
+parent never touches the device, so it always emits the JSON line
+(value: null + error on failure) within the total budget.
 """
 
 import json
+import math
 import os
+import subprocess
 import sys
 import time
-
-import numpy as np
 
 _ROOT = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, _ROOT)
 
 BASELINE_IMG_S = 256 * 20 / 19.2  # K40 + cuDNN, reference docs
+PROBE_DEADLINE_S = 90       # tiny device op, incl. client init + tunnel RTT
+TOTAL_BUDGET_S = 450        # hard cap: probe + compile (~40s) + 23 steps
+_IS_CHILD = os.environ.get("CAFFE_TPU_BENCH_CHILD") == "1"
+
+# debug knobs (the headline metric is always batch 256, 20 iters; overriding
+# any knob renames the metric so a debug line can't be mistaken for it)
+BATCH = int(os.environ.get("CAFFE_BENCH_BATCH", 256))
+WARMUP = int(os.environ.get("CAFFE_BENCH_WARMUP", 3))
+ITERS = int(os.environ.get("CAFFE_BENCH_ITERS", 20))
+_IS_DEBUG = (BATCH, ITERS) != (256, 20)
+METRIC = ("alexnet_b256_train_img_per_s_1chip" if not _IS_DEBUG
+          else f"debug_alexnet_b{BATCH}_i{ITERS}_train_img_per_s_1chip")
 
 
-def main():
+def emit(value=None, vs_baseline=None, extra=None, error=None):
+    line = {"metric": METRIC, "value": value, "unit": "img/s",
+            "vs_baseline": vs_baseline}
+    if extra:
+        line.update(extra)
+    if error:
+        line["error"] = error
+    print(json.dumps(line))
+    sys.stdout.flush()
+
+
+def probe():
+    """Touch the device from a THROWAWAY process with a deadline. A dead
+    tunnel makes the first jax call hang forever; only a separate process
+    can be abandoned safely (jax would cache the dead PJRT client)."""
+    code = ("import jax, jax.numpy as jnp; d = jax.devices()[0]; "
+            "x = float(jnp.sum(jnp.ones(16))); "
+            "print(d.platform, d.device_kind, sep='|')")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=PROBE_DEADLINE_S)
+    except subprocess.TimeoutExpired:
+        return (f"device probe timed out after {PROBE_DEADLINE_S}s "
+                "(TPU tunnel down?)")
+    if r.returncode != 0:
+        return "device probe failed: " + r.stderr.strip()[-300:]
+    return None
+
+
+def run_bench():
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from caffe_mpi_tpu.proto import NetParameter, SolverParameter
     from caffe_mpi_tpu.solver import Solver
+    from caffe_mpi_tpu.utils.flops import peak_flops, train_flops_per_image
 
-    batch = 256
     sp = SolverParameter.from_file(
         os.path.join(_ROOT, "models/alexnet/solver.prototxt"))
     sp.max_iter = 10**9
     sp.display = 0
     sp.snapshot = 0
     sp.test_interval = 0
+    if BATCH != 256:  # debug runs: rewrite the Input batch dim
+        npar = NetParameter.from_file(os.path.join(_ROOT, sp.net))
+        for l in npar.layer:
+            if l.type == "Input":
+                for shp in l.input_param.shape:
+                    shp.dim[0] = BATCH
+        sp.net = ""
+        sp.net_param = npar
     solver = Solver(sp, model_dir=_ROOT)
 
     r = np.random.RandomState(0)
     feeds = {
-        "data": jnp.asarray(r.randn(batch, 3, 227, 227).astype(np.float32)),
-        "label": jnp.asarray(r.randint(0, 1000, batch)),
+        "data": jnp.asarray(r.randn(BATCH, 3, 227, 227).astype(np.float32)),
+        "label": jnp.asarray(r.randint(0, 1000, BATCH)),
     }
     feed_fn = lambda it: feeds
 
     # warmup (compile + first steps)
-    solver.step(3, feed_fn)
+    solver.step(WARMUP, feed_fn)
     jax.block_until_ready(solver.params)
 
-    iters = 20
     t0 = time.perf_counter()
-    solver.step(iters, feed_fn)
+    solver.step(ITERS, feed_fn)
     jax.block_until_ready(solver.params)
     dt = time.perf_counter() - t0
 
-    img_s = batch * iters / dt
-    # f32 storage/accumulation; MXU multiplies at XLA default precision —
-    # the TPU analogue of NVCaffe's tensor-op math override. Forcing
-    # full-f32 multiplies (default_forward_math: FLOAT) measures ~half this.
-    print(json.dumps({
-        "metric": "alexnet_b256_train_img_per_s_1chip",
-        "value": round(img_s, 1),
-        "unit": "img/s",
-        "vs_baseline": round(img_s / BASELINE_IMG_S, 2),
-    }))
+    img_s = BATCH * ITERS / dt
+    flops_img = train_flops_per_image(solver.net)
+    achieved = flops_img * img_s
+    device = jax.devices()[0]
+    peak = peak_flops(device)
+    extra = {
+        "device": device.device_kind,
+        "model_tflops_per_s": round(achieved / 1e12, 2),
+        "mfu": round(achieved / peak, 4) if peak else None,
+    }
+    return round(img_s, 1), round(img_s / BASELINE_IMG_S, 2), extra
+
+
+def _attempt(deadline_s):
+    """Run the bench body in a watched child; return (json_line|None, err)."""
+    env = dict(os.environ, CAFFE_TPU_BENCH_CHILD="1")
+    try:
+        r = subprocess.run([sys.executable, __file__], env=env, text=True,
+                           capture_output=True, timeout=deadline_s)
+    except subprocess.TimeoutExpired:
+        return None, f"bench attempt exceeded its {deadline_s:.0f}s deadline"
+    sys.stderr.write(r.stderr)
+    if r.returncode == 0 and r.stdout.strip():
+        return r.stdout.strip().splitlines()[-1], None
+    tail = [l for l in r.stderr.strip().splitlines() if l.strip()]
+    return None, (tail[-1][-300:] if tail
+                  else f"bench child exited rc={r.returncode}")
 
 
 if __name__ == "__main__":
-    # one retry IN A FRESH PROCESS: the TPU tunnel in this environment
-    # occasionally drops a claim, and jax caches the dead PJRT client, so
-    # an in-process retry would reuse the broken connection
-    try:
-        main()
-    except Exception:
-        import subprocess
-        import traceback
-        traceback.print_exc()
-        if os.environ.get("CAFFE_TPU_BENCH_RETRY") == "1":
-            sys.exit(1)
-        print("bench attempt 1 failed; retrying in a fresh process",
-              file=sys.stderr)
-        time.sleep(30)
-        env = dict(os.environ, CAFFE_TPU_BENCH_RETRY="1")
-        sys.exit(subprocess.run([sys.executable, __file__], env=env).returncode)
+    if _IS_CHILD:
+        # child: device work only; crash loudly on failure (parent reports)
+        value, vs, extra = run_bench()
+        emit(value, vs, extra)
+        sys.exit(0)
+
+    start = time.monotonic()
+    err = probe()
+    if err:
+        emit(error=err)
+        sys.exit(0)
+
+    last_err = "unknown"
+    for attempt in (1, 2):
+        remaining = TOTAL_BUDGET_S - (time.monotonic() - start) - 10
+        if attempt == 2:
+            # a dropped tunnel claim takes a moment to release; give it a
+            # bounded backoff without blowing the budget
+            backoff = min(30, remaining - 70)
+            if backoff > 0:
+                print(f"bench attempt 1 failed ({last_err}); retrying in "
+                      f"{backoff:.0f}s", file=sys.stderr)
+                time.sleep(backoff)
+                remaining -= backoff
+        if remaining < 60:
+            break
+        line, last_err = _attempt(remaining)
+        if line is not None:
+            print(line)
+            sys.exit(0)
+    emit(error=last_err)
+    sys.exit(0)

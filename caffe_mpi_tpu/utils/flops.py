@@ -1,0 +1,71 @@
+"""Analytic FLOPs / MFU accounting.
+
+Replaces: nothing in the reference — Caffe-MPI reports img/s only
+(solver.cpp:619-628). MFU (model FLOPs utilization: achieved FLOP/s over
+the chip's peak) is the TPU-native efficiency metric: img/s depends on the
+model, MFU says how much of the MXU the program actually keeps busy, which
+is what XLA tuning moves.
+
+The count is *model* FLOPs (the textbook cost of the layers, not whatever
+the compiler executed): conv and matmul MACs only — elementwise/pool/norm
+ops are HBM-bound noise next to the MXU terms. Backward costs 2x forward
+(one matmul each for d-input and d-weight per forward matmul).
+"""
+
+from __future__ import annotations
+
+import math
+
+
+def layer_macs_per_image(layer) -> int:
+    """Multiply-accumulates per image for one layer (0 for non-MXU ops)."""
+    t = layer.type_name
+    if t not in ("Convolution", "Deconvolution", "InnerProduct"):
+        return 0
+    wsize = math.prod(layer.params["weight"].shape)
+    if t == "Convolution":
+        # weight (Cout, Cin/g, kh, kw); each output position costs
+        # Cin/g*kh*kw MACs for each of Cout channels = weight.size
+        _, _, oh, ow = layer.out_shapes[0]
+        return wsize * oh * ow
+    if t == "Deconvolution":
+        _, _, ih, iw = layer.in_shapes[0]
+        return wsize * ih * iw
+    return wsize
+
+
+def net_macs_per_image(net) -> int:
+    return sum(layer_macs_per_image(l) for l in net.layers)
+
+
+def train_flops_per_image(net) -> int:
+    """fwd (2 FLOPs/MAC) + bwd (2x fwd: d-input and d-weight matmuls)."""
+    return 6 * net_macs_per_image(net)
+
+
+# Peak dense-matmul FLOP/s per chip at the MXU's native precision
+# (bf16 multiply, f32 accumulate) — the denominator for MFU. Sources:
+# jax-ml.github.io/scaling-book hardware table / Google Cloud TPU docs.
+PEAK_FLOPS_BY_KIND = {
+    "TPU v2": 46e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v4 lite": 138e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def peak_flops(device) -> float | None:
+    """Peak FLOP/s for a jax device, or None when the kind is unknown."""
+    kind = getattr(device, "device_kind", "")
+    if kind in PEAK_FLOPS_BY_KIND:
+        return PEAK_FLOPS_BY_KIND[kind]
+    for k, v in PEAK_FLOPS_BY_KIND.items():
+        if kind.startswith(k):
+            return v
+    return None

@@ -43,7 +43,7 @@ _IS_CHILD = os.environ.get("CAFFE_TPU_BENCH_CHILD") == "1"
 BATCH = int(os.environ.get("CAFFE_BENCH_BATCH", 256))
 WARMUP = int(os.environ.get("CAFFE_BENCH_WARMUP", 3))
 ITERS = int(os.environ.get("CAFFE_BENCH_ITERS", 20))
-_IS_DEBUG = (BATCH, ITERS) != (256, 20)
+_IS_DEBUG = (BATCH, ITERS, WARMUP) != (256, 20, 3)
 METRIC = ("alexnet_b256_train_img_per_s_1chip" if not _IS_DEBUG
           else f"debug_alexnet_b{BATCH}_i{ITERS}_train_img_per_s_1chip")
 

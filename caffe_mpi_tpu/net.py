@@ -63,6 +63,8 @@ class Net:
         self.name = param.name
 
         self.layers: list[Layer] = []
+        self._layer_index: dict[str, Layer] = {}
+        self._indexed_upto = 0
         self.blob_shapes: dict[str, tuple] = {}
         self.feed_blobs: list[str] = []  # blob names fed from host
         self.loss_blobs: list[tuple[str, float]] = []  # (blob, weight)
@@ -179,10 +181,17 @@ class Net:
             p.batch_size = max(1, (p.batch_size + divisor - 1) // divisor)
 
     def _layer_by_name(self, name: str) -> Layer:
-        for l in self.layers:
-            if l.name == name:
-                return l
-        raise KeyError(name)
+        # built lazily: callers run both during Init (partial layer list)
+        # and after; an O(n) scan inside the build loop made net
+        # construction O(n^2) (inception_v3 has ~350 layers)
+        idx = self._layer_index
+        for i in range(self._indexed_upto, len(self.layers)):
+            idx.setdefault(self.layers[i].name, self.layers[i])
+        self._indexed_upto = len(self.layers)
+        try:
+            return idx[name]
+        except KeyError:
+            raise KeyError(name) from None
 
     # ------------------------------------------------------------------
     def init(self, key: jax.Array) -> tuple[Params, State]:

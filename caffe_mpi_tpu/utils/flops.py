@@ -65,7 +65,9 @@ def peak_flops(device) -> float | None:
     kind = getattr(device, "device_kind", "")
     if kind in PEAK_FLOPS_BY_KIND:
         return PEAK_FLOPS_BY_KIND[kind]
-    for k, v in PEAK_FLOPS_BY_KIND.items():
+    # longest prefix wins: 'TPU v5 lite pod' must match 'TPU v5 lite',
+    # not 'TPU v5'
+    for k in sorted(PEAK_FLOPS_BY_KIND, key=len, reverse=True):
         if kind.startswith(k):
-            return v
+            return PEAK_FLOPS_BY_KIND[k]
     return None

@@ -189,6 +189,18 @@ class TestTestInterval:
         scores = solver.test_all([lambda k: feed(9000 + k)])
         assert scores[0]["acc"] > 0.9
 
+        # score parity: the device-accumulated averages must equal a naive
+        # per-iteration host-side average of the test net's outputs
+        tnet = solver.test_nets[0]
+        naive = {}
+        for k in range(4):  # test_iter: 4
+            blobs = tnet.apply(solver._shared_params(tnet), solver.net_state,
+                               feed(9000 + k), train=False)[0]
+            for b in ("acc",):
+                naive[b] = naive.get(b, 0.0) + float(jnp.sum(blobs[b]))
+        for b in naive:
+            assert scores[0][b] == pytest.approx(naive[b] / 4, rel=1e-6)
+
 
 class TestEndToEnd:
     def test_lsq_converges(self, rng):

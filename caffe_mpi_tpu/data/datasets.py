@@ -10,10 +10,10 @@ which subsumes the reference's forward-only cursor and lets the deterministic
 round-robin record partitioning of CursorManager (data_reader.hpp:28-53)
 be an index calculation instead of a cursor-skipping protocol.
 
-LMDB support is gated on the `lmdb` module (not in this image); the same
-Datum wire format is parsed with the in-repo protobuf-wire reader, so LMDBs
-written by the reference's convert_imageset load unchanged where lmdb is
-available.
+LMDB needs no third-party module: lmdb_io.py implements the on-disk B+tree
+format directly (mmap reader + bulk writer), so LMDBs written by the
+reference's convert_imageset load unchanged in this image; the python
+`lmdb` module is used instead when it happens to be installed.
 """
 
 from __future__ import annotations
@@ -134,25 +134,33 @@ def encode_datum(arr: np.ndarray, label: int) -> bytes:
 
 class LMDBDataset:
     """Reads LMDBs written by the reference's convert_imageset
-    (db_lmdb.cpp). Requires the optional `lmdb` module."""
+    (db_lmdb.cpp). Uses the python `lmdb` module when present, else the
+    in-repo dependency-free B+tree reader (data/lmdb_io.py) — either way,
+    reference-written LMDBs load unchanged."""
 
     def __init__(self, path: str):
         try:
             import lmdb
-        except ImportError as e:
-            raise ImportError(
-                "LMDB support requires the 'lmdb' python module, which is "
-                "not installed in this environment"
-            ) from e
-        self.env = lmdb.open(path, readonly=True, lock=False,
-                             readahead=False, meminit=False)
-        with self.env.begin() as txn:
-            self.keys = [k for k, _ in txn.cursor()]
+        except ImportError:
+            lmdb = None
+        if lmdb is not None:
+            self.env = lmdb.open(path, readonly=True, lock=False,
+                                 readahead=False, meminit=False)
+            with self.env.begin() as txn:
+                self.keys = [k for k, _ in txn.cursor()]
+            self._reader = None
+        else:
+            from .lmdb_io import LMDBReader
+            self.env = None
+            self._reader = LMDBReader(path)
+            self.keys = list(self._reader.keys())
 
     def __len__(self) -> int:
         return len(self.keys)
 
     def get(self, index: int) -> tuple[np.ndarray, int]:
+        if self._reader is not None:
+            return parse_datum(self._reader.get(self.keys[index]))
         with self.env.begin() as txn:
             return parse_datum(txn.get(self.keys[index]))
 

@@ -63,16 +63,19 @@ def main(argv=None) -> int:
     gen = iter_datums(args.root, items,
                       (args.resize_height, args.resize_width), args.gray)
     if args.backend == "lmdb":
+        # the reference keys records "%08d_filename" (convert_imageset.cpp);
+        # zero-padded index keys preserve insertion order lexicographically
+        pairs = ((f"{i:08d}".encode(), buf) for i, buf in enumerate(gen))
         try:
             import lmdb
         except ImportError:
-            print("lmdb module not available; use -backend datumfile",
-                  file=sys.stderr)
-            return 1
-        env = lmdb.open(args.db_name, map_size=1 << 40)
-        with env.begin(write=True) as txn:
-            for i, buf in enumerate(gen):
-                txn.put(f"{i:08d}".encode(), buf)
+            from ..data.lmdb_io import write_lmdb
+            write_lmdb(args.db_name, pairs)
+        else:
+            env = lmdb.open(args.db_name, map_size=1 << 40)
+            with env.begin(write=True) as txn:
+                for k, buf in pairs:
+                    txn.put(k, buf)
         count = len(items)
     else:
         from ..data.datasets import DatumFileDataset

@@ -128,6 +128,150 @@ class TestDatasets:
         assert img.shape == (3, 8, 8) and lab == 1
 
 
+class TestLMDB:
+    """The dependency-free LMDB B+tree reader/writer (data/lmdb_io.py),
+    mirroring reference test_db.cpp: build a fixture DB on the fly, walk it
+    with a cursor, point-look-up keys. No third-party lmdb import anywhere."""
+
+    def _roundtrip(self, tmp_path, items, **kw):
+        from caffe_mpi_tpu.data.lmdb_io import LMDBReader, write_lmdb
+        path = str(tmp_path / "db")
+        write_lmdb(path, items, **kw)
+        with LMDBReader(path) as r:
+            assert len(r) == len(items)
+            got = list(r.items())
+        want = sorted(items, key=lambda kv: kv[0])
+        assert [k for k, _ in got] == [k for k, _ in want]
+        assert [v for _, v in got] == [v for _, v in want]
+        with LMDBReader(path) as r:
+            for k, v in want:
+                assert r.get(k) == v
+            assert r.get(b"\xffnope") is None
+        return path
+
+    def test_single_leaf(self, tmp_path):
+        items = [(f"{i:08d}".encode(), f"value-{i}".encode())
+                 for i in range(10)]
+        self._roundtrip(tmp_path, items)
+
+    def test_multi_level_tree(self, tmp_path):
+        # ~66-byte nodes -> ~50/leaf -> 3000 records forces depth >= 3
+        items = [(f"{i:08d}".encode(), (f"v{i}" * 10).encode())
+                 for i in range(3000)]
+        self._roundtrip(tmp_path, items)
+
+    def test_overflow_values(self, tmp_path):
+        # values over the ~2KB node budget go to F_BIGDATA overflow chains
+        rng = np.random.RandomState(3)
+        items = [(f"{i:04d}".encode(),
+                  rng.bytes(sz))
+                 for i, sz in enumerate([10, 3000, 5000, 100, 4096, 9000])]
+        self._roundtrip(tmp_path, items)
+
+    def test_empty_db(self, tmp_path):
+        from caffe_mpi_tpu.data.lmdb_io import LMDBReader, write_lmdb
+        path = str(tmp_path / "db")
+        write_lmdb(path, [])
+        with LMDBReader(path) as r:
+            assert len(r) == 0
+            assert list(r.items()) == []
+            assert r.get(b"x") is None
+
+    def test_nosubdir_file(self, tmp_path):
+        from caffe_mpi_tpu.data.lmdb_io import LMDBReader, write_lmdb
+        path = str(tmp_path / "flat.mdb")
+        write_lmdb(path, [(b"k", b"v")], subdir=False)
+        with LMDBReader(path) as r:
+            assert r.get(b"k") == b"v"
+
+    def test_bad_magic_rejected(self, tmp_path):
+        from caffe_mpi_tpu.data.lmdb_io import LMDBError, LMDBReader
+        p = tmp_path / "junk"
+        p.mkdir()
+        (p / "data.mdb").write_bytes(b"\x00" * 8192)
+        with pytest.raises(LMDBError):
+            LMDBReader(str(p))
+
+    def test_on_disk_layout_matches_mdb_c(self, tmp_path):
+        """Byte-level check of the emitted file against offsets hard-coded
+        straight from mdb.c's struct definitions (NOT via lmdb_io's own
+        constants) — catches the reader and writer sharing one mistake."""
+        from caffe_mpi_tpu.data.lmdb_io import write_lmdb
+        path = write_lmdb(str(tmp_path / "db"), [(b"abc", b"de")])
+        raw = open(path, "rb").read()
+        # MDB_page header: u64 pgno, u16 pad, u16 flags(P_META=0x08),
+        # u16 lower, u16 upper; PAGEHDRSZ == 16
+        assert struct.unpack_from("<Q", raw, 0)[0] == 0          # meta0 pgno
+        assert struct.unpack_from("<H", raw, 10)[0] & 0x08       # P_META
+        # MDB_meta at +16: mm_magic, mm_version
+        assert struct.unpack_from("<I", raw, 16)[0] == 0xBEEFC0DE
+        assert struct.unpack_from("<I", raw, 20)[0] == 1         # data ver
+        # mm_dbs[0].md_pad at +16+24 carries the page size (mm_psize)
+        assert struct.unpack_from("<I", raw, 40)[0] == 4096
+        # mm_dbs[1] (main) at +16+24+48: md_depth at +8, md_entries at +32,
+        # md_root at +40
+        main = 16 + 24 + 48
+        assert struct.unpack_from("<H", raw, main + 6)[0] == 1   # depth
+        assert struct.unpack_from("<Q", raw, main + 32)[0] == 1  # entries
+        root = struct.unpack_from("<Q", raw, main + 40)[0]
+        assert root == 2
+        # meta1 at offset psize, txnid at meta base + 24+48*2+8
+        assert struct.unpack_from("<Q", raw, 4096 + 16 + 128)[0] == 1
+        # root leaf page: flags has P_LEAF=0x02; one node; node at ptrs[0]:
+        # u16 lo(dsize)=2, u16 hi=0, u16 flags=0, u16 ksize=3, "abc", "de"
+        off = root * 4096
+        assert struct.unpack_from("<H", raw, off + 10)[0] & 0x02
+        lower, upper = struct.unpack_from("<HH", raw, off + 12)
+        assert (lower - 16) >> 1 == 1                            # NUMKEYS
+        (ptr,) = struct.unpack_from("<H", raw, off + 16)
+        assert ptr == upper
+        lo, hi, nflags, ksize = struct.unpack_from("<HHHH", raw, off + ptr)
+        assert (lo | hi << 16, nflags, ksize) == (2, 0, 3)
+        assert raw[off + ptr + 8: off + ptr + 13] == b"abcde"
+
+    def test_datum_lmdb_dataset(self, tmp_path):
+        """A Datum LMDB round-trips through LMDBDataset with no
+        third-party import (the reference data_layer path, db_lmdb.cpp)."""
+        from caffe_mpi_tpu.data.datasets import LMDBDataset
+        from caffe_mpi_tpu.data.lmdb_io import write_lmdb
+        rng = np.random.RandomState(7)
+        imgs = rng.randint(0, 256, (5, 3, 6, 4), dtype=np.uint8)
+        labels = [3, 1, 4, 1, 5]
+        items = [(f"{i:08d}".encode(), encode_datum(imgs[i], labels[i]))
+                 for i in range(5)]
+        path = str(tmp_path / "datums")
+        write_lmdb(path, items)
+        ds = LMDBDataset(path)
+        assert len(ds) == 5
+        for i in range(5):
+            arr, lab = ds.get(i)
+            np.testing.assert_array_equal(arr, imgs[i])
+            assert lab == labels[i]
+
+    def test_convert_imageset_lmdb_backend(self, tmp_path):
+        """convert_imageset -backend lmdb works without the lmdb module and
+        the result feeds LMDBDataset (reference tools/convert_imageset.cpp)."""
+        from PIL import Image
+        from caffe_mpi_tpu.data.datasets import LMDBDataset
+        from caffe_mpi_tpu.tools.convert_imageset import main as convert_main
+        rng = np.random.RandomState(11)
+        img_dir = tmp_path / "imgs"
+        img_dir.mkdir()
+        lines = []
+        for i in range(4):
+            arr = rng.randint(0, 256, (5, 7, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(img_dir / f"im{i}.png")
+            lines.append(f"im{i}.png {i % 2}")
+        listfile = tmp_path / "list.txt"
+        listfile.write_text("\n".join(lines) + "\n")
+        db = str(tmp_path / "out_lmdb")
+        assert convert_main([str(img_dir), str(listfile), db]) == 0
+        ds = LMDBDataset(db)
+        assert len(ds) == 4
+        arr, lab = ds.get(2)
+        assert arr.shape == (3, 5, 7) and lab == 0
+
+
 class TestTransformer:
     def test_scale_mean_value(self):
         tp = TransformationParameter.from_text(

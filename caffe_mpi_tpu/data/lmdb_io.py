@@ -305,7 +305,8 @@ def write_lmdb(path: str, items, psize: int = 4096,
     (me_nodemax = (psize - PAGEHDRSZ)/2 & -2). Returns the data file path.
     """
     if isinstance(items, (list, tuple)):
-        items = sorted(items, key=lambda kv: kv[0])
+        # mdb_put semantics: last write to a key wins
+        items = {k: v for k, v in sorted(items, key=lambda kv: kv[0])}.items()
     nodemax = ((psize - PAGEHDRSZ) // 2) & ~1
     maxkey = nodemax - 8 - 8  # node header + overflow pgno must also fit
 
@@ -346,6 +347,10 @@ def write_lmdb(path: str, items, psize: int = 4096,
             if len(key) > maxkey:
                 raise LMDBError(f"key too long ({len(key)} > {maxkey})")
             if prev_key is not None and key <= prev_key:
+                if key == prev_key:
+                    raise LMDBError(
+                        f"duplicate key {key!r} in stream (pass a list to "
+                        "get mdb_put last-write-wins semantics)")
                 raise LMDBError(
                     "streamed items must have strictly ascending keys "
                     f"({key!r} after {prev_key!r}); pass a list to sort")

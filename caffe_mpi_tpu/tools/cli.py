@@ -43,6 +43,8 @@ def _parser() -> argparse.ArgumentParser:
                            help="feed random data into Input layers")),
         ("profile", dict(default="", help="write a JAX/XLA profiler trace "
                                           "(xplane) to this directory")),
+        ("max_iter", dict(type=int, default=0,
+                          help="override solver max_iter (0 = prototxt)")),
     ]:
         p.add_argument(f"-{flag}", f"--{flag}", **kw)
     return p
@@ -100,6 +102,8 @@ def cmd_train(args) -> int:
     import os
     from ..data.feeder import data_shape_probe
     sp = SolverParameter.from_file(args.solver)
+    if args.max_iter:
+        sp.max_iter = args.max_iter
     model_dir = os.path.dirname(os.path.abspath(args.solver)) \
         if not (sp.net and os.path.exists(sp.net)) else ""
     solver = Solver(sp, mesh=_select_mesh(args.gpu), model_dir=model_dir,

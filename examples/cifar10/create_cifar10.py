@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Create cifar10_{train,test}_lmdb + mean.binaryproto.
+
+Mirrors the reference's examples/cifar10/create_cifar10.sh +
+convert_cifar_data.cpp (binary batches -> LMDB) + compute_image_mean.
+With --synthetic, generates a separable 10-class 32x32x3 task instead —
+same shapes, same wire formats — so the example runs without the dataset.
+
+Usage:
+    python examples/cifar10/create_cifar10.py [--dir examples/cifar10] \
+        [--cifar-dir DIR_WITH_data_batch_N.bin] [--synthetic] \
+        [--train-n 2000] [--test-n 500]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def synthetic_cifar(n: int, seed: int, classes: int = 10):
+    rng = np.random.RandomState(seed)
+    templates = rng.randint(0, 256, (classes, 3, 32, 32))
+    labels = rng.randint(0, classes, n)
+    noise = rng.randint(-40, 41, (n, 3, 32, 32))
+    imgs = np.clip(templates[labels] + noise, 0, 255).astype(np.uint8)
+    return imgs, labels
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    here = os.path.dirname(os.path.abspath(__file__))
+    p.add_argument("--dir", default=here)
+    p.add_argument("--cifar-dir", default=here,
+                   help="directory holding data_batch_{1..5}.bin + "
+                        "test_batch.bin")
+    p.add_argument("--synthetic", action="store_true")
+    p.add_argument("--train-n", type=int, default=2000)
+    p.add_argument("--test-n", type=int, default=500)
+    args = p.parse_args(argv)
+
+    from caffe_mpi_tpu.data.datasets import CIFAR10Dataset, encode_datum
+    from caffe_mpi_tpu.data.lmdb_io import write_lmdb
+    from caffe_mpi_tpu.io import save_blob_binaryproto
+
+    splits = {}
+    if args.synthetic:
+        splits["train"] = synthetic_cifar(args.train_n, seed=0)
+        splits["test"] = synthetic_cifar(args.test_n, seed=1)
+    else:
+        train_batches = [os.path.join(args.cifar_dir, f"data_batch_{i}.bin")
+                         for i in range(1, 6)]
+        test_batch = os.path.join(args.cifar_dir, "test_batch.bin")
+        missing = [f for f in train_batches + [test_batch]
+                   if not os.path.exists(f)]
+        if missing:
+            print(f"missing {missing[0]} (+{len(missing) - 1} more); get the "
+                  "CIFAR-10 binary batches, or pass --synthetic",
+                  file=sys.stderr)
+            return 1
+        for split, paths in (("train", train_batches), ("test", [test_batch])):
+            ds = CIFAR10Dataset(*paths)
+            pairs = [ds.get(i) for i in range(len(ds))]  # single decode pass
+            splits[split] = (np.stack([im for im, _ in pairs]),
+                             np.asarray([lab for _, lab in pairs]))
+
+    for split, (imgs, labels) in splits.items():
+        db = os.path.join(args.dir, f"cifar10_{split}_lmdb")
+        write_lmdb(db, ((f"{i:05d}".encode(), encode_datum(imgs[i],
+                                                           int(labels[i])))
+                        for i in range(len(labels))))
+        print(f"wrote {len(labels)} records to {db}")
+
+    # dataset mean over the TRAIN split (reference compute_image_mean)
+    mean = splits["train"][0].astype(np.float64).mean(axis=0)
+    mean_path = os.path.join(args.dir, "mean.binaryproto")
+    save_blob_binaryproto(mean_path, mean.astype(np.float32)[None])
+    print(f"wrote {mean_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+"""Train CIFAR-10 quick end-to-end: create the DBs if needed, run
+`caffe train` (mirrors the reference's examples/cifar10/train_quick.sh).
+Falls back to the synthetic separable task when the CIFAR binaries are
+absent, so the example always runs.
+
+Usage:
+    python examples/cifar10/run.py [-max_iter N] [-gpu all|id]
+"""
+
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.abspath(os.path.join(_HERE, "..", "..")))
+
+
+def main(argv=None) -> int:
+    from examples.common import run_example
+    from examples.cifar10.create_cifar10 import main as create_main
+    return run_example(
+        _HERE,
+        artifacts=["cifar10_train_lmdb", "cifar10_test_lmdb",
+                   "mean.binaryproto"],
+        create_main=create_main,
+        real_marker="data_batch_1.bin",
+        solver="examples/cifar10/cifar10_quick_solver.prototxt",
+        argv=argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

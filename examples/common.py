@@ -1,0 +1,44 @@
+"""Shared driver for the runnable examples (mirrors the reference's
+train_*.sh scripts: ensure the DBs exist, then exec `caffe train`)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                     ".."))
+
+
+def run_example(here: str, artifacts: list[str], create_main,
+                real_marker: str, solver: str, argv=None) -> int:
+    """Create missing dataset artifacts, then run `caffe train -solver ...`.
+
+    artifacts: every file/dir the net prototxt needs (train+test DBs, mean
+    file, ...) — creation re-runs unless ALL exist, so a partially-created
+    dataset is repaired. real_marker: a file whose presence means the real
+    dataset is available (else --synthetic).
+    """
+    sys.path.insert(0, _ROOT)
+    p = argparse.ArgumentParser()
+    p.add_argument("-max_iter", "--max_iter", type=int, default=0,
+                   help="override solver max_iter (0 = use the prototxt)")
+    p.add_argument("-gpu", "--gpu", default="",
+                   help="forwarded to caffe train (e.g. 'all')")
+    args = p.parse_args(argv)
+
+    if not all(os.path.exists(os.path.join(here, a)) for a in artifacts):
+        have_real = os.path.exists(os.path.join(here, real_marker))
+        rc = create_main([] if have_real else ["--synthetic"])
+        if rc:
+            return rc
+
+    from caffe_mpi_tpu.tools.cli import main as caffe_main
+    cli = ["train", "-solver", solver]
+    if args.max_iter:
+        cli += ["-max_iter", str(args.max_iter)]
+    if args.gpu:
+        cli += ["-gpu", args.gpu]
+    os.chdir(_ROOT)  # solver paths are repo-relative, like the reference's
+    return caffe_main(cli)

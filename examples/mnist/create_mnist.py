@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Create mnist_train_lmdb / mnist_test_lmdb.
+
+Mirrors the reference's examples/mnist/create_mnist.sh +
+convert_mnist_data.cpp (idx files -> LMDB of Datum records), using the
+dependency-free LMDB writer. With --synthetic (or when the idx files are
+absent and --synthetic is passed), generates a separable 10-class
+28x28 task instead — same shapes, same wire format — so the example runs
+in a zero-egress environment.
+
+Usage:
+    python examples/mnist/create_mnist.py [--dir examples/mnist] \
+        [--synthetic] [--train-n 2000] [--test-n 500]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+IDX_FILES = {
+    "train": ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+    "test": ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
+}
+
+
+def synthetic_mnist(n: int, seed: int, classes: int = 10):
+    """Separable cluster task: one fixed random template per class,
+    samples are the template plus pixel noise."""
+    rng = np.random.RandomState(seed)
+    templates = rng.randint(0, 256, (classes, 1, 28, 28))
+    labels = rng.randint(0, classes, n)
+    noise = rng.randint(-40, 41, (n, 1, 28, 28))
+    imgs = np.clip(templates[labels] + noise, 0, 255).astype(np.uint8)
+    return imgs, labels
+
+
+def write_split(db_path: str, imgs, labels):
+    from caffe_mpi_tpu.data.datasets import encode_datum
+    from caffe_mpi_tpu.data.lmdb_io import write_lmdb
+
+    write_lmdb(db_path, ((f"{i:08d}".encode(), encode_datum(imgs[i],
+                                                            int(labels[i])))
+                         for i in range(len(labels))))
+    print(f"wrote {len(labels)} records to {db_path}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--dir", default=os.path.dirname(os.path.abspath(__file__)))
+    p.add_argument("--synthetic", action="store_true",
+                   help="generate a separable synthetic task instead of "
+                        "reading idx files")
+    p.add_argument("--train-n", type=int, default=2000)
+    p.add_argument("--test-n", type=int, default=500)
+    args = p.parse_args(argv)
+
+    for split, seed, n in (("train", 0, args.train_n),
+                           ("test", 1, args.test_n)):
+        db = os.path.join(args.dir, f"mnist_{split}_lmdb")
+        if args.synthetic:
+            imgs, labels = synthetic_mnist(n, seed)
+        else:
+            from caffe_mpi_tpu.data import MNISTDataset
+            img_f, lab_f = (os.path.join(args.dir, f)
+                            for f in IDX_FILES[split])
+            if not (os.path.exists(img_f) and os.path.exists(lab_f)):
+                print(f"missing {img_f} / {lab_f}; download MNIST idx files "
+                      "here, or pass --synthetic", file=sys.stderr)
+                return 1
+            ds = MNISTDataset(img_f, lab_f)
+            pairs = [ds.get(i) for i in range(len(ds))]  # single decode pass
+            imgs = np.stack([im for im, _ in pairs])
+            labels = np.asarray([lab for _, lab in pairs])
+        write_split(db, imgs, labels)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

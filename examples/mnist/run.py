@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""Train LeNet end-to-end: create the DBs if needed, run `caffe train`.
+
+Mirrors the reference's examples/mnist/train_lenet.sh (which invokes
+`caffe train -solver lenet_solver.prototxt` after create_mnist.sh). With
+no MNIST idx files present, falls back to the synthetic separable task so
+the example always runs.
+
+Usage:
+    python examples/mnist/run.py [-max_iter N] [-gpu all|id]
+"""
+
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.abspath(os.path.join(_HERE, "..", "..")))
+
+
+def main(argv=None) -> int:
+    from examples.common import run_example
+    from examples.mnist.create_mnist import main as create_main
+    return run_example(
+        _HERE,
+        artifacts=["mnist_train_lmdb", "mnist_test_lmdb"],
+        create_main=create_main,
+        real_marker="train-images-idx3-ubyte",
+        solver="examples/mnist/lenet_solver.prototxt",
+        argv=argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

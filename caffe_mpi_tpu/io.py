@@ -191,6 +191,14 @@ def parse_caffemodel(buf: bytes) -> dict[str, list[np.ndarray]]:
                     name = v2.decode("utf-8")
                 elif f2 == 6 and w2 == 2:
                     blobs.append(parse_blob(v2))
+                elif f2 == 1 and w2 == 2:
+                    # nested V0LayerParameter (caffe.proto:1473): name=1,
+                    # blobs=50 — V0-era .caffemodel files store weights here
+                    for f3, w3, v3 in _fields(v2):
+                        if f3 == 1 and w3 == 2:
+                            name = v3.decode("utf-8")
+                        elif f3 == 50 and w3 == 2:
+                            blobs.append(parse_blob(v3))
             if blobs:
                 out[name] = blobs
     return out
@@ -260,3 +268,79 @@ def load_weights(path: str) -> dict[str, list[np.ndarray]]:
     if path.endswith((".h5", ".hdf5")):
         return load_caffemodel_h5(path)
     return load_caffemodel(path)
+
+
+# -- SolverState (.solverstate) ---------------------------------------------
+# Reference caffe.proto:303-308: iter=1 (varint), learned_net=2 (string),
+# history=3 (repeated BlobProto), current_step=4 (varint). History blobs
+# are the optimizer slots of the learnable params in net order, slot-major:
+# history[i + s*N] = slot s of param i (Adam/AdaDelta append the second
+# bank after the first, sgd_solver.cpp PreSolve + adam_solver.cpp:37-39).
+
+def encode_solverstate(it: int, learned_net: str,
+                       history: list[np.ndarray],
+                       current_step: int = 0) -> bytes:
+    out = bytearray()
+    out += _tag(1, 0) + _varint(it)
+    if learned_net:
+        nm = learned_net.encode("utf-8")
+        out += _tag(2, 2) + _varint(len(nm)) + nm
+    for blob in history:
+        b = encode_blob(np.asarray(blob))
+        out += _tag(3, 2) + _varint(len(b)) + b
+    if current_step:
+        out += _tag(4, 0) + _varint(current_step)
+    return bytes(out)
+
+
+def parse_solverstate(buf: bytes) -> tuple[int, str, list[np.ndarray], int]:
+    it, learned_net, history, current_step = 0, "", [], 0
+    for field, wire, val in _fields(buf):
+        if field == 1 and wire == 0:
+            it = int(val)
+        elif field == 2 and wire == 2:
+            learned_net = val.decode("utf-8")
+        elif field == 3 and wire == 2:
+            history.append(parse_blob(val))
+        elif field == 4 and wire == 0:
+            current_step = int(val)
+    return it, learned_net, history, current_step
+
+
+def save_solverstate(path: str, it: int, learned_net: str,
+                     history: list[np.ndarray], current_step: int = 0) -> None:
+    with open(path, "wb") as f:
+        f.write(encode_solverstate(it, learned_net, history, current_step))
+
+
+def load_solverstate(path: str) -> tuple[int, str, list[np.ndarray], int]:
+    with open(path, "rb") as f:
+        return parse_solverstate(f.read())
+
+
+def save_solverstate_h5(path: str, it: int, learned_net: str,
+                        history: list[np.ndarray],
+                        current_step: int = 0) -> None:
+    """Reference SnapshotSolverStateToHDF5 layout (sgd_solver.cpp:293-310):
+    /iter, /learned_net, /current_step scalars + /history/<i> datasets."""
+    import h5py
+    with h5py.File(path, "w") as f:
+        f.create_dataset("iter", data=np.int32(it))
+        f.create_dataset("learned_net", data=learned_net)
+        f.create_dataset("current_step", data=np.int32(current_step))
+        g = f.create_group("history")
+        for i, blob in enumerate(history):
+            g.create_dataset(str(i), data=np.asarray(blob, np.float32))
+
+
+def load_solverstate_h5(path: str) -> tuple[int, str, list[np.ndarray], int]:
+    import h5py
+    with h5py.File(path, "r") as f:
+        it = int(np.asarray(f["iter"]))
+        ln = f["learned_net"][()]
+        learned_net = ln.decode("utf-8") if isinstance(ln, bytes) else str(ln)
+        current_step = int(np.asarray(f["current_step"])) \
+            if "current_step" in f else 0
+        g = f["history"]
+        history = [np.asarray(g[str(i)]) for i in range(len(g.keys()))]
+    return it, learned_net, history, current_step

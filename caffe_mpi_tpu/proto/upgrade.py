@@ -15,12 +15,24 @@ import dataclasses
 
 from .config import (
     BlobShape,
+    ConvolutionParameter,
+    DataParameter,
+    DropoutParameter,
+    FillerParameter,
+    HDF5DataParameter,
+    ImageDataParameter,
+    InfogainLossParameter,
+    InnerProductParameter,
     InputParameter,
     LayerParameter,
+    LRNParameter,
     NetParameter,
     NetState,
     NetStateRule,
     ParamSpec,
+    PoolingParameter,
+    TransformationParameter,
+    WindowDataParameter,
 )
 
 # V1LayerParameter ALL-CAPS enum -> modern type string
@@ -55,6 +67,7 @@ def normalize_net(net: NetParameter) -> NetParameter:
         net.layer = net.layers
         net.layers = []
     for lp in net.layer:
+        _migrate_v0_layer(lp)
         if lp.type in _V1_TYPE_NAMES:
             lp.type = _V1_TYPE_NAMES[lp.type]
         _migrate_v1_blob_multipliers(lp)
@@ -80,6 +93,137 @@ def normalize_net(net: NetParameter) -> NetParameter:
         net.layer.insert(0, lp)
         net.input, net.input_shape, net.input_dim = [], [], []
     return net
+
+
+# V0 string type -> modern type name (upgrade_proto.cpp UpgradeV0LayerType)
+_V0_TYPE_NAMES = {
+    "accuracy": "Accuracy", "bnll": "BNLL", "concat": "Concat",
+    "conv": "Convolution", "data": "Data", "dropout": "Dropout",
+    "euclidean_loss": "EuclideanLoss", "flatten": "Flatten",
+    "hdf5_data": "HDF5Data", "hdf5_output": "HDF5Output",
+    "im2col": "Im2col", "images": "ImageData",
+    "infogain_loss": "InfogainLoss", "innerproduct": "InnerProduct",
+    "lrn": "LRN", "multinomial_logistic_loss": "MultinomialLogisticLoss",
+    "pool": "Pooling", "relu": "ReLU", "sigmoid": "Sigmoid",
+    "softmax": "Softmax", "softmax_loss": "SoftmaxWithLoss",
+    "split": "Split", "tanh": "TanH", "window_data": "WindowData",
+}
+
+
+def _migrate_v0_layer(lp: LayerParameter) -> None:
+    """V0 'layers { layer { ... } bottom: ... }' -> modern LayerParameter
+    (reference upgrade_proto.cpp UpgradeV0LayerParameter ~1.2k LoC; the
+    V0LayerParameter schema is caffe.proto:1473-1559). V0 keeps every
+    hyperparameter flat inside the nested `layer` message; this expands
+    them into today's typed *_param sub-messages in place."""
+    node = getattr(lp, "_node", None)
+    if node is None or "layer" not in node:
+        return
+    v0 = node.get("layer")
+    v0_type = str(v0.get("type", ""))
+    if v0_type == "padding":
+        raise ValueError(
+            "V0 'padding' layers are not supported: fold the pad into the "
+            "following conv layer (reference UpgradeV0PaddingLayers)")
+    if v0_type not in _V0_TYPE_NAMES:
+        raise ValueError(f"unknown V0 layer type {v0_type!r}")
+    lp.name = str(v0.get("name", ""))
+    lp.type = _V0_TYPE_NAMES[v0_type]
+
+    def filler(key):
+        n = v0.get(key)
+        return FillerParameter.from_node(n) if n is not None else None
+
+    if v0_type == "conv":
+        lp.convolution_param = ConvolutionParameter(
+            num_output=int(v0.get("num_output", 0)),
+            bias_term=bool(v0.get("biasterm", True)),
+            pad=[int(v0.get("pad"))] if "pad" in v0 else [],
+            kernel_size=[int(v0.get("kernelsize", 0))],
+            stride=[int(v0.get("stride"))] if "stride" in v0 else [],
+            group=int(v0.get("group", 1)),
+            weight_filler=filler("weight_filler"),
+            bias_filler=filler("bias_filler"))
+    elif v0_type == "innerproduct":
+        lp.inner_product_param = InnerProductParameter(
+            num_output=int(v0.get("num_output", 0)),
+            bias_term=bool(v0.get("biasterm", True)),
+            weight_filler=filler("weight_filler"),
+            bias_filler=filler("bias_filler"))
+    elif v0_type == "pool":
+        pool = v0.get("pool", "MAX")
+        pool = {0: "MAX", 1: "AVE", 2: "STOCHASTIC"}.get(pool, str(pool))
+        lp.pooling_param = PoolingParameter(
+            pool=pool,
+            kernel_size=int(v0.get("kernelsize", 0)),
+            stride=int(v0.get("stride", 1)),
+            pad=int(v0.get("pad", 0)))
+    elif v0_type == "dropout":
+        lp.dropout_param = DropoutParameter(
+            dropout_ratio=float(v0.get("dropout_ratio", 0.5)))
+    elif v0_type == "lrn":
+        lp.lrn_param = LRNParameter(
+            local_size=int(v0.get("local_size", 5)),
+            alpha=float(v0.get("alpha", 1.0)),
+            beta=float(v0.get("beta", 0.75)),
+            k=float(v0.get("k", 1.0)))
+    elif v0_type == "infogain_loss":
+        lp.infogain_loss_param = InfogainLossParameter(
+            source=str(v0.get("source", "")))
+    elif v0_type in ("data", "images", "window_data", "hdf5_data"):
+        _migrate_v0_data_fields(lp, v0, v0_type)
+
+    # per-blob multipliers live on the V0 node (fields 51/52)
+    lrs = [float(x) for x in v0.get_list("blobs_lr")]
+    wds = [float(x) for x in v0.get_list("weight_decay")]
+    for i in range(max(len(lrs), len(wds))):
+        spec = ParamSpec()
+        if i < len(lrs):
+            spec.lr_mult = lrs[i]
+        if i < len(wds):
+            spec.decay_mult = wds[i]
+        lp.param.append(spec)
+    # consume the node so downstream V1 migration doesn't re-run on it
+    del node.fields["layer"]
+    if hasattr(lp, "_unknown") and "layer" in lp._unknown:
+        lp._unknown.remove("layer")
+
+
+def _migrate_v0_data_fields(lp: LayerParameter, v0, v0_type: str) -> None:
+    """V0 data layers keep source/batchsize + transform fields flat; the
+    modern schema splits them into data-source params + transform_param
+    (the reference does this over two upgrades: V0->V1 then
+    UpgradeNetDataTransformation)."""
+    tp = TransformationParameter(
+        scale=float(v0.get("scale", 1.0)),
+        mean_file=str(v0.get("meanfile", "")),
+        crop_size=int(v0.get("cropsize", 0)),
+        mirror=bool(v0.get("mirror", False)))
+    if (tp.scale != 1.0 or tp.mean_file or tp.crop_size or tp.mirror):
+        lp.transform_param = tp
+    src = str(v0.get("source", ""))
+    batch = int(v0.get("batchsize", 0))
+    if v0_type == "data":
+        lp.data_param = DataParameter(
+            source=src, batch_size=batch,
+            rand_skip=int(v0.get("rand_skip", 0)))
+    elif v0_type == "images":
+        lp.image_data_param = ImageDataParameter(
+            source=src, batch_size=batch,
+            rand_skip=int(v0.get("rand_skip", 0)),
+            shuffle=bool(v0.get("shuffle_images", False)),
+            new_height=int(v0.get("new_height", 0)),
+            new_width=int(v0.get("new_width", 0)))
+    elif v0_type == "window_data":
+        lp.window_data_param = WindowDataParameter(
+            source=src, batch_size=batch,
+            fg_threshold=float(v0.get("det_fg_threshold", 0.5)),
+            bg_threshold=float(v0.get("det_bg_threshold", 0.5)),
+            fg_fraction=float(v0.get("det_fg_fraction", 0.25)),
+            context_pad=int(v0.get("det_context_pad", 0)),
+            crop_mode=str(v0.get("det_crop_mode", "warp")))
+    elif v0_type == "hdf5_data":
+        lp.hdf5_data_param = HDF5DataParameter(source=src, batch_size=batch)
 
 
 def _migrate_v1_blob_multipliers(lp: LayerParameter) -> None:

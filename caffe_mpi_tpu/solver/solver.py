@@ -310,9 +310,15 @@ class Solver:
     def solve(self, feed_fn: FeedFn, test_feed_fns=None) -> float:
         """Train to max_iter (reference Solver::Solve)."""
         loss = self.step(self.sp.max_iter - self.iter, feed_fn, test_feed_fns)
-        if self.sp.snapshot_after_train:
+        if self.should_snapshot_after_train():
             self.snapshot()
         return loss
+
+    def should_snapshot_after_train(self) -> bool:
+        """After-train snapshot, unless the interval snapshot just fired
+        (reference solver.cpp:402-407)."""
+        return bool(self.sp.snapshot_after_train and (
+            not self.sp.snapshot or self.iter % self.sp.snapshot != 0))
 
     def _batch_images(self) -> int:
         for blob in self.net.feed_blobs:
@@ -398,33 +404,99 @@ class Solver:
             model_path = f"{prefix}_iter_{self.iter}.caffemodel"
             caffe_io.save_caffemodel(model_path, weights,
                                      self.net.name, layer_types)
-        state_path = f"{prefix}_iter_{self.iter}.solverstate.npz"
-        flat = {"meta/iter": np.asarray(self.iter),
-                "meta/model": np.asarray(model_path)}
-        for lname, lo in self.opt_state.items():
-            for pname, slots in lo.items():
-                for si, arr in enumerate(slots):
-                    flat[f"opt/{lname}/{pname}/{si}"] = np.asarray(arr)
-        np.savez(state_path, **flat)
+        # solver state in the reference's own formats (caffe.proto:303-308):
+        # .solverstate binaryproto by default, .solverstate.h5 for HDF5 —
+        # a reference build can resume our snapshots and vice versa
+        history = self._history_blobs()
+        if str(self.sp.snapshot_format).upper() == "HDF5":
+            state_path = f"{prefix}_iter_{self.iter}.solverstate.h5"
+            caffe_io.save_solverstate_h5(state_path, self.iter, model_path,
+                                         history, self._current_step())
+        else:
+            state_path = f"{prefix}_iter_{self.iter}.solverstate"
+            caffe_io.save_solverstate(state_path, self.iter, model_path,
+                                      history, self._current_step())
         log.info("Snapshotting to %s + %s", model_path, state_path)
         return state_path
 
+    def _history_blobs(self) -> list:
+        """Optimizer slots as the reference's flat history list: params in
+        net order, slot-major (history[i + s*N] = slot s of param i;
+        sgd_solver.cpp PreSolve + adam_solver.cpp:37-39)."""
+        decls = list(self.net.learnable_param_decls())
+        slots_per = max((len(self.opt_state[l][p]) for l, p, _ in decls),
+                        default=0)
+        out = []
+        for s in range(slots_per):
+            for lname, pname, _ in decls:
+                out.append(np.asarray(self.opt_state[lname][pname][s]))
+        return out
+
+    def _current_step(self) -> int:
+        """Reference current_step_: multistep stage index (solver.cpp)."""
+        if str(self.sp.lr_policy) == "multistep":
+            return sum(1 for v in self.sp.stepvalue if self.iter >= v)
+        return 0
+
     def restore(self, path: str) -> None:
-        """Resume from a .solverstate.npz (reference Solver::Restore)."""
+        """Resume from a .solverstate{,.h5,.npz} (reference
+        Solver::Restore / SGDSolver::RestoreSolverStateFromBinaryProto).
+        Reads reference-written binaryproto states directly."""
         from .. import io as caffe_io
-        data = np.load(path)
-        self.iter = int(data["meta/iter"])
-        model_path = str(data["meta/model"])
-        self.load_weights(model_path)
-        for key in data.files:
-            parts = key.split("/")
-            if parts[0] == "opt":
-                _, lname, pname, si = parts
-                slots = list(self.opt_state[lname][pname])
-                slots[int(si)] = jnp.asarray(data[key])
-                self.opt_state[lname][pname] = tuple(slots)
+        if path.endswith(".npz"):  # this framework's pre-interop format
+            data = np.load(path)
+            self.iter = int(data["meta/iter"])
+            model_path = str(data["meta/model"])
+            self._load_snapshot_weights(model_path, path)
+            for key in data.files:
+                parts = key.split("/")
+                if parts[0] == "opt":
+                    _, lname, pname, si = parts
+                    slots = list(self.opt_state[lname][pname])
+                    slots[int(si)] = jnp.asarray(data[key])
+                    self.opt_state[lname][pname] = tuple(slots)
+        else:
+            loader = (caffe_io.load_solverstate_h5
+                      if path.endswith((".h5", ".hdf5"))
+                      else caffe_io.load_solverstate)
+            it, learned_net, history, _step = loader(path)
+            self.iter = it
+            if learned_net:
+                self._load_snapshot_weights(learned_net, path)
+            decls = list(self.net.learnable_param_decls())
+            n = len(decls)
+            slots_per = len(self.opt_state[decls[0][0]][decls[0][1]]) \
+                if decls else 0
+            # strict like the reference's CHECK_EQ on history size
+            # (sgd_solver.cpp:324): a bank-count mismatch means the
+            # snapshot came from a different solver type
+            if len(history) != n * slots_per:
+                raise ValueError(
+                    f"solverstate history has {len(history)} blobs; this "
+                    f"solver expects {n} params x {slots_per} slots = "
+                    f"{n * slots_per} (snapshot from a different solver "
+                    "type?)")
+            for i, (lname, pname, _) in enumerate(decls):
+                cur = self.opt_state[lname][pname]
+                new = []
+                for s in range(len(cur)):
+                    arr = history[i + s * n].reshape(np.shape(cur[s]) or ())
+                    new.append(jnp.asarray(arr, cur[s].dtype
+                                           if hasattr(cur[s], "dtype")
+                                           else None))
+                self.opt_state[lname][pname] = tuple(new)
         self._place_params_opt()
         log.info("Restored solver state from %s (iter %d)", path, self.iter)
+
+    def _load_snapshot_weights(self, model_path: str, state_path: str) -> None:
+        """learned_net paths are stored as written (often relative to the
+        training cwd); fall back to resolving next to the state file."""
+        if not os.path.exists(model_path):
+            cand = os.path.join(os.path.dirname(os.path.abspath(state_path)),
+                                os.path.basename(model_path))
+            if os.path.exists(cand):
+                model_path = cand
+        self.load_weights(model_path)
 
     def load_weights(self, path: str) -> None:
         """Finetune-style weight load (reference `caffe train -weights`)."""

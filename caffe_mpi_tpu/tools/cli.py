@@ -6,7 +6,7 @@ Reference: tools/caffe.cpp (499 LoC): command registry, gflags (-solver,
 timing benchmark (`caffe time`, tools/caffe.cpp:328-445).
 
 Usage (gflags-compatible single-dash long flags accepted):
-    python -m caffe_mpi_tpu.tools.cli train -solver solver.prototxt [-weights w.caffemodel | -snapshot s.solverstate.npz] [-gpu all]
+    python -m caffe_mpi_tpu.tools.cli train -solver solver.prototxt [-weights w.caffemodel | -snapshot s.solverstate] [-gpu all]
     python -m caffe_mpi_tpu.tools.cli test -model net.prototxt -weights w.caffemodel -iterations 50
     python -m caffe_mpi_tpu.tools.cli time -model net.prototxt -iterations 50
     python -m caffe_mpi_tpu.tools.cli device_query
@@ -33,7 +33,7 @@ def _parser() -> argparse.ArgumentParser:
         ("solver", dict(default="", help="solver prototxt")),
         ("model", dict(default="", help="net prototxt")),
         ("weights", dict(default="", help=".caffemodel[.h5] to load")),
-        ("snapshot", dict(default="", help=".solverstate.npz to resume")),
+        ("snapshot", dict(default="", help=".solverstate[.h5|.npz] to resume")),
         ("gpu", dict(default="", help="'all' = full device mesh, or index")),
         ("iterations", dict(type=int, default=50)),
         ("sigint_effect", dict(default="stop", choices=["stop", "snapshot", "none"])),
@@ -170,8 +170,10 @@ def cmd_train(args) -> int:
             state["snap"] = False
             solver.snapshot()
     if (state["stop"] and args.sigint_effect == "stop") or (
-            not state["stop"] and sp.snapshot_after_train and sp.snapshot_prefix):
-        solver.snapshot()  # reference snapshots at stop/after-train (solver.cpp:402-407)
+            not state["stop"] and sp.snapshot_prefix
+            and solver.should_snapshot_after_train()):
+        solver.snapshot()  # reference snapshots at stop/after-train
+        # (solver.cpp:402-407)
     elapsed = time.time() - t0
     imgs = (solver.iter - start_iter) * solver._batch_images() * max(sp.iter_size, 1)
     log.info("Optimization done: %d iters, %.1f s, %.1f img/s overall",

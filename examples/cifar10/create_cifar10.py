@@ -25,12 +25,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 
 def synthetic_cifar(n: int, seed: int, classes: int = 10):
-    rng = np.random.RandomState(seed)
-    templates = rng.randint(0, 256, (classes, 3, 32, 32))
-    labels = rng.randint(0, classes, n)
-    noise = rng.randint(-40, 41, (n, 3, 32, 32))
-    imgs = np.clip(templates[labels] + noise, 0, 255).astype(np.uint8)
-    return imgs, labels
+    from examples.common import synthetic_clusters
+    return synthetic_clusters(n, (3, 32, 32), seed, classes)
 
 
 def main(argv=None) -> int:

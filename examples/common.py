@@ -11,6 +11,23 @@ _ROOT = os.path.abspath(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                      ".."))
 
 
+def synthetic_clusters(n: int, shape: tuple, seed: int, classes: int = 10,
+                       template_seed: int = 42, noise: int = 40):
+    """Separable cluster task: one fixed random uint8 template per class
+    (shared across splits via template_seed), samples = template + pixel
+    noise. The zero-egress stand-in for MNIST/CIFAR in the examples AND
+    the convergence tests — one definition so the tests prove the task the
+    examples actually run."""
+    import numpy as np
+    templates = np.random.RandomState(template_seed).randint(
+        0, 256, (classes, *shape))
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, classes, n)
+    delta = rng.randint(-noise, noise + 1, (n, *shape))
+    imgs = np.clip(templates[labels] + delta, 0, 255).astype("uint8")
+    return imgs, labels
+
+
 def run_example(here: str, artifacts: list[str], create_main,
                 real_marker: str, solver: str, argv=None) -> int:
     """Create missing dataset artifacts, then run `caffe train -solver ...`.

@@ -31,14 +31,8 @@ IDX_FILES = {
 
 
 def synthetic_mnist(n: int, seed: int, classes: int = 10):
-    """Separable cluster task: one fixed random template per class,
-    samples are the template plus pixel noise."""
-    rng = np.random.RandomState(seed)
-    templates = rng.randint(0, 256, (classes, 1, 28, 28))
-    labels = rng.randint(0, classes, n)
-    noise = rng.randint(-40, 41, (n, 1, 28, 28))
-    imgs = np.clip(templates[labels] + noise, 0, 255).astype(np.uint8)
-    return imgs, labels
+    from examples.common import synthetic_clusters
+    return synthetic_clusters(n, (1, 28, 28), seed, classes)
 
 
 def write_split(db_path: str, imgs, labels):

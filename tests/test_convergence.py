@@ -24,18 +24,15 @@ _ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 
 def _make_synthetic_lmdbs(tmp_path, shape, train_n, test_n, classes=10):
+    import sys
+    sys.path.insert(0, _ROOT)
+    from examples.common import synthetic_clusters  # THE examples' task
     from caffe_mpi_tpu.data.datasets import encode_datum
     from caffe_mpi_tpu.data.lmdb_io import write_lmdb
 
     paths = {}
-    # one fixed template per class, shared by both splits (the test split
-    # is held-out noise around the same clusters)
-    templates = np.random.RandomState(42).randint(0, 256, (classes, *shape))
-    for split, seed, n in (("train", 10, train_n), ("test", 11, test_n)):
-        rng = np.random.RandomState(seed)
-        labels = rng.randint(0, classes, n)
-        noise = rng.randint(-40, 41, (n, *shape))
-        imgs = np.clip(templates[labels] + noise, 0, 255).astype(np.uint8)
+    for split, seed, n in (("train", 0, train_n), ("test", 1, test_n)):
+        imgs, labels = synthetic_clusters(n, shape, seed, classes)
         db = str(tmp_path / f"{split}_lmdb")
         write_lmdb(db, ((f"{i:08d}".encode(), encode_datum(imgs[i],
                                                            int(labels[i])))

@@ -103,6 +103,22 @@ class TestWeightRoundTrip:
         np.testing.assert_array_equal(np.array(params2["conv1"]["weight"]), w0)
         np.testing.assert_array_equal(np.array(params2["ip"]["weight"]), 1.0)
 
+    def test_v0_binary_caffemodel_blobs(self):
+        """V0-era .caffemodel: weights nested as layers{layer{name=1,
+        blobs=50}} (caffe.proto:1473,1515). Hand-encode the wire bytes and
+        parse them."""
+        from caffe_mpi_tpu.io import _tag, _varint, encode_blob, \
+            parse_caffemodel
+        w = np.arange(6, dtype=np.float32).reshape(2, 3)
+        blob = encode_blob(w)
+        v0 = (_tag(1, 2) + _varint(len(b"ipw")) + b"ipw"
+              + _tag(50, 2) + _varint(len(blob)) + blob)
+        v1 = _tag(1, 2) + _varint(len(v0)) + v0
+        buf = _tag(2, 2) + _varint(len(v1)) + v1
+        out = parse_caffemodel(bytes(buf))
+        assert list(out) == ["ipw"]
+        np.testing.assert_array_equal(out["ipw"][0], w)
+
     def test_shape_mismatch_raises(self):
         net, params, state = build()
         with pytest.raises(ValueError, match="incompatible"):

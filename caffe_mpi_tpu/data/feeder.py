@@ -219,9 +219,15 @@ class HDF5Feeder:
     """Feeds batches from HDF5 files listed in a source file (reference
     hdf5_data_layer.cpp: datasets named by the layer's top blobs).
 
-    Files are loaded into preallocated host arrays (single copy); with
-    shuffle enabled, a fresh seed-derived permutation is drawn every epoch,
-    matching the reference's per-pass reshuffle."""
+    STREAMING, file-at-a-time like the reference (LoadHDF5FileData loads
+    one file, advances to the next when exhausted): peak RSS is bounded by
+    the two largest files (a batch may straddle a boundary), never the
+    whole dataset — an ImageNet-scale HDF5 source streams through. With
+    shuffle, the file ORDER is re-drawn per epoch and rows are permuted
+    per (epoch, file), mirroring the reference's file_permutation_ +
+    data_permutation_ pair."""
+
+    _CACHE_FILES = 2  # current + boundary-straddling neighbor
 
     def __init__(self, lp, *, model_dir: str = "", rank: int = 0,
                  world: int = 1, seed: int = 1701):
@@ -233,44 +239,98 @@ class HDF5Feeder:
         self.rank, self.world = rank, world
         self.shuffle = bool(p.shuffle)
         self.seed = seed
-        files = _h5_list_files(_os.path.join(model_dir, p.source))
-        # first pass: shapes only; preallocate to avoid a 2x concat copy
-        lengths = []
-        dtypes: dict[str, np.dtype] = {}
-        shapes: dict[str, tuple] = {}
-        for path in files:
+        self.files = _h5_list_files(_os.path.join(model_dir, p.source))
+        # shape/dtype scan only — no data read until a batch needs it, but
+        # every file must agree on tops, dtypes, and row shapes NOW (a
+        # mismatch discovered mid-epoch would silently change the jitted
+        # step's input dtype or KeyError long into training)
+        self.lengths = []
+        sig: dict[str, tuple] | None = None
+        for path in self.files:
             with h5py.File(path, "r") as h5:
-                lengths.append(len(h5[self.tops[0]]))
-                for t in self.tops:
-                    dtypes[t] = h5[t].dtype
-                    shapes[t] = tuple(h5[t].shape[1:])
-        self.n = sum(lengths)
-        self.arrays = {t: np.empty((self.n, *shapes[t]), dtypes[t])
-                       for t in self.tops}
-        pos = 0
-        for path, ln in zip(files, lengths):
-            with h5py.File(path, "r") as h5:
-                for t in self.tops:
-                    h5[t].read_direct(self.arrays[t],
-                                      dest_sel=np.s_[pos:pos + ln])
-            pos += ln
-        self._perms: dict[int, np.ndarray] = {}
+                missing = [t for t in self.tops if t not in h5]
+                if missing:
+                    raise ValueError(f"{path}: missing dataset(s) {missing}")
+                this = {t: (h5[t].dtype, tuple(h5[t].shape[1:]))
+                        for t in self.tops}
+                if sig is None:
+                    sig = this
+                elif this != sig:
+                    raise ValueError(
+                        f"{path}: dtype/shape {this} differs from first "
+                        f"file's {sig}")
+                self.lengths.append(len(h5[self.tops[0]]))
+        self.n = sum(self.lengths)
+        self._cache: dict[int, dict[str, np.ndarray]] = {}  # file -> arrays
+        self._cache_order: list[int] = []
+        # permutations memoized for the CURRENT epoch only (the old
+        # all-in-RAM feeder kept one epoch perm the same way)
+        self._perm_epoch = -1
+        self._file_order_cache: np.ndarray | None = None
+        self._row_perms: dict[int, np.ndarray] = {}
 
-    def _index(self, flat: int) -> int:
-        epoch, within = divmod(flat, self.n)
+    # -- index plumbing ---------------------------------------------------
+    def _epoch_perms(self, epoch: int):
+        if epoch != self._perm_epoch:
+            self._perm_epoch = epoch
+            self._file_order_cache = np.random.RandomState(
+                self.seed + epoch).permutation(len(self.files))
+            self._row_perms = {}
+        return self._file_order_cache
+
+    def _file_order(self, epoch: int) -> np.ndarray:
         if not self.shuffle:
-            return within
-        perm = self._perms.get(epoch)
+            return np.arange(len(self.files))
+        return self._epoch_perms(epoch)
+
+    def _row_perm(self, epoch: int, fi: int) -> np.ndarray | None:
+        if not self.shuffle:
+            return None
+        self._epoch_perms(epoch)
+        perm = self._row_perms.get(fi)
         if perm is None:
-            perm = np.random.RandomState(self.seed + epoch).permutation(self.n)
-            self._perms = {epoch: perm}  # keep only the current epoch
-        return int(perm[within])
+            perm = np.random.RandomState(
+                (self.seed * 31 + epoch * 7919 + fi) % (2**32)).permutation(
+                    self.lengths[fi])
+            self._row_perms[fi] = perm
+        return perm
+
+    def _locate(self, flat: int) -> tuple[int, int]:
+        """Global sample index -> (file index, row index)."""
+        epoch, within = divmod(flat, self.n)
+        order = self._file_order(epoch)
+        for fi in order:
+            ln = self.lengths[fi]
+            if within < ln:
+                perm = self._row_perm(epoch, int(fi))
+                return int(fi), int(perm[within]) if perm is not None \
+                    else within
+            within -= ln
+        raise AssertionError("index out of epoch range")
+
+    def _file_arrays(self, fi: int) -> dict[str, np.ndarray]:
+        arrays = self._cache.get(fi)
+        if arrays is None:
+            import h5py
+            with h5py.File(self.files[fi], "r") as h5:
+                arrays = {t: np.asarray(h5[t]) for t in self.tops}
+            self._cache[fi] = arrays
+            self._cache_order.append(fi)
+            while len(self._cache_order) > self._CACHE_FILES:
+                self._cache.pop(self._cache_order.pop(0), None)
+        return arrays
 
     def __call__(self, it: int) -> dict[str, np.ndarray]:
-        idx = [self._index(it * self.batch * self.world
-                           + self.rank * self.batch + k)
-               for k in range(self.batch)]
-        return {t: self.arrays[t][idx] for t in self.tops}
+        locs = [self._locate(it * self.batch * self.world
+                             + self.rank * self.batch + k)
+                for k in range(self.batch)]
+        out = {t: [] for t in self.tops}
+        for fi, row in locs:
+            arrays = self._file_arrays(fi)
+            for t in self.tops:
+                out[t].append(arrays[t][row])
+        return {t: np.stack(v) for t, v in out.items()}
 
     def close(self) -> None:
-        pass
+        self._cache.clear()
+        self._cache_order.clear()

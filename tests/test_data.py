@@ -272,6 +272,98 @@ class TestLMDB:
         assert arr.shape == (3, 5, 7) and lab == 0
 
 
+class TestHDF5Feeder:
+    """Streaming file-at-a-time HDF5 feeding (reference hdf5_data_layer.cpp
+    LoadHDF5FileData semantics: bounded memory, per-epoch file shuffle)."""
+
+    def _make_source(self, tmp_path, sizes=(5, 7, 4)):
+        import h5py
+        paths = []
+        base = 0
+        for i, n in enumerate(sizes):
+            p = tmp_path / f"part{i}.h5"
+            with h5py.File(p, "w") as f:
+                f["data"] = np.arange(base, base + n,
+                                      dtype=np.float32).reshape(n, 1)
+                f["label"] = np.arange(base, base + n, dtype=np.int64)
+            base += n
+            paths.append(p.name)
+        src = tmp_path / "source.txt"
+        src.write_text("\n".join(paths) + "\n")
+        return str(src)
+
+    def _feeder(self, tmp_path, batch=4, shuffle=False, **kw):
+        from caffe_mpi_tpu.data.feeder import HDF5Feeder
+        from caffe_mpi_tpu.proto import NetParameter
+        src = self._make_source(tmp_path)
+        lp = NetParameter.from_text(f"""
+            layer {{ name: "h" type: "HDF5Data" top: "data" top: "label"
+                    hdf5_data_param {{ source: "{src}" batch_size: {batch}
+                                       shuffle: {'true' if shuffle else 'false'} }} }}
+        """).layer[0]
+        return HDF5Feeder(lp, **kw)
+
+    def test_epoch_covers_all_rows_in_file_order(self, tmp_path):
+        f = self._feeder(tmp_path, batch=4)
+        seen = []
+        for it in range(4):  # 16 = one epoch fits exactly
+            seen.extend(f(it)["label"].tolist())
+        assert seen == list(range(16))  # file order, row order
+        # second epoch repeats
+        assert f(4)["label"].tolist() == [0, 1, 2, 3]
+
+    def test_cache_bounded_to_two_files(self, tmp_path):
+        f = self._feeder(tmp_path, batch=4)
+        for it in range(8):
+            f(it)
+            assert len(f._cache) <= 2
+
+    def test_shuffle_deterministic_and_complete(self, tmp_path):
+        f1 = self._feeder(tmp_path, batch=4, shuffle=True)
+        f2 = self._feeder(tmp_path, batch=4, shuffle=True)
+        e1 = [x for it in range(4) for x in f1(it)["label"].tolist()]
+        e2 = [x for it in range(4) for x in f2(it)["label"].tolist()]
+        assert e1 == e2                      # seed-deterministic
+        assert sorted(e1) == list(range(16))  # full coverage
+        next_epoch = [x for it in range(4, 8)
+                      for x in f1(it)["label"].tolist()]
+        assert sorted(next_epoch) == list(range(16))
+        assert next_epoch != e1              # re-shuffled per epoch
+
+    def test_rank_striping_disjoint(self, tmp_path):
+        f0 = self._feeder(tmp_path, batch=4, rank=0, world=2)
+        f1 = self._feeder(tmp_path, batch=4, rank=1, world=2)
+        a = f0(0)["label"].tolist()
+        b = f1(0)["label"].tolist()
+        assert not set(a) & set(b)
+        assert a + b == list(range(8))
+
+    def test_mixed_dtype_files_rejected_at_init(self, tmp_path):
+        import h5py
+        from caffe_mpi_tpu.data.feeder import HDF5Feeder
+        from caffe_mpi_tpu.proto import NetParameter
+        with h5py.File(tmp_path / "a.h5", "w") as f:
+            f["data"] = np.zeros((4, 2), np.float32)
+            f["label"] = np.zeros(4, np.int64)
+        with h5py.File(tmp_path / "b.h5", "w") as f:
+            f["data"] = np.zeros((4, 2), np.float64)  # dtype differs
+            f["label"] = np.zeros(4, np.int64)
+        src = tmp_path / "s.txt"
+        src.write_text("a.h5\nb.h5\n")
+        lp = NetParameter.from_text(f"""
+            layer {{ name: "h" type: "HDF5Data" top: "data" top: "label"
+                    hdf5_data_param {{ source: "{src}" batch_size: 2 }} }}
+        """).layer[0]
+        with pytest.raises(ValueError, match="differs from first"):
+            HDF5Feeder(lp)
+
+    def test_data_rows_match_labels(self, tmp_path):
+        f = self._feeder(tmp_path, batch=6, shuffle=True)
+        out = f(0)
+        np.testing.assert_array_equal(out["data"][:, 0],
+                                      out["label"].astype(np.float32))
+
+
 class TestTransformer:
     def test_scale_mean_value(self):
         tp = TransformationParameter.from_text(

@@ -261,52 +261,38 @@ class HDF5Feeder:
                         f"file's {sig}")
                 self.lengths.append(len(h5[self.tops[0]]))
         self.n = sum(self.lengths)
+        self.lengths = np.asarray(self.lengths)
+        self._sig = sig
         self._cache: dict[int, dict[str, np.ndarray]] = {}  # file -> arrays
         self._cache_order: list[int] = []
-        # permutations memoized for the CURRENT epoch only (the old
-        # all-in-RAM feeder kept one epoch perm the same way)
-        self._perm_epoch = -1
-        self._file_order_cache: np.ndarray | None = None
+        # epoch layout (file order + cumulative bounds + row perms)
+        # memoized for the CURRENT epoch only, like the reference's
+        # file_permutation_/data_permutation_ pair
+        self._layout_epoch = -1
+        self._order: np.ndarray | None = None
+        self._cum: np.ndarray | None = None
         self._row_perms: dict[int, np.ndarray] = {}
 
     # -- index plumbing ---------------------------------------------------
-    def _epoch_perms(self, epoch: int):
-        if epoch != self._perm_epoch:
-            self._perm_epoch = epoch
-            self._file_order_cache = np.random.RandomState(
+    def _epoch_layout(self, epoch: int):
+        """(file order, cumulative end positions) for one epoch."""
+        if epoch != self._layout_epoch:
+            self._layout_epoch = epoch
+            self._order = (np.random.RandomState(
                 self.seed + epoch).permutation(len(self.files))
+                if self.shuffle else np.arange(len(self.files)))
+            self._cum = np.cumsum(self.lengths[self._order])
             self._row_perms = {}
-        return self._file_order_cache
+        return self._order, self._cum
 
-    def _file_order(self, epoch: int) -> np.ndarray:
-        if not self.shuffle:
-            return np.arange(len(self.files))
-        return self._epoch_perms(epoch)
-
-    def _row_perm(self, epoch: int, fi: int) -> np.ndarray | None:
-        if not self.shuffle:
-            return None
-        self._epoch_perms(epoch)
+    def _row_perm(self, epoch: int, fi: int) -> np.ndarray:
         perm = self._row_perms.get(fi)
         if perm is None:
             perm = np.random.RandomState(
                 (self.seed * 31 + epoch * 7919 + fi) % (2**32)).permutation(
-                    self.lengths[fi])
+                    int(self.lengths[fi]))
             self._row_perms[fi] = perm
         return perm
-
-    def _locate(self, flat: int) -> tuple[int, int]:
-        """Global sample index -> (file index, row index)."""
-        epoch, within = divmod(flat, self.n)
-        order = self._file_order(epoch)
-        for fi in order:
-            ln = self.lengths[fi]
-            if within < ln:
-                perm = self._row_perm(epoch, int(fi))
-                return int(fi), int(perm[within]) if perm is not None \
-                    else within
-            within -= ln
-        raise AssertionError("index out of epoch range")
 
     def _file_arrays(self, fi: int) -> dict[str, np.ndarray]:
         arrays = self._cache.get(fi)
@@ -321,15 +307,38 @@ class HDF5Feeder:
         return arrays
 
     def __call__(self, it: int) -> dict[str, np.ndarray]:
-        locs = [self._locate(it * self.batch * self.world
-                             + self.rank * self.batch + k)
-                for k in range(self.batch)]
-        out = {t: [] for t in self.tops}
-        for fi, row in locs:
-            arrays = self._file_arrays(fi)
+        flats = (it * self.batch * self.world + self.rank * self.batch
+                 + np.arange(self.batch))
+        epochs = flats // self.n
+        within = flats % self.n
+        # vectorized (epoch, within) -> (file, row): searchsorted over the
+        # epoch's cumulative file bounds — O(batch log n_files), no
+        # per-sample Python scan
+        fis = np.empty(self.batch, np.int64)
+        rows = np.empty(self.batch, np.int64)
+        for ep in np.unique(epochs):
+            m = epochs == ep
+            order, cum = self._epoch_layout(int(ep))
+            pos = np.searchsorted(cum, within[m], side="right")
+            fi = order[pos]
+            rows_in = within[m] - (cum[pos] - self.lengths[fi])
+            if self.shuffle:
+                rows_in = np.asarray(
+                    [self._row_perm(int(ep), int(f))[r]
+                     for f, r in zip(fi, rows_in)])
+            fis[m] = fi
+            rows[m] = rows_in
+        # one fancy-index COPY per spanned file (rows grouped by file):
+        # no views pin evicted cache entries, so peak RSS really is
+        # bounded by the cached files plus the batch itself
+        out = {t: np.empty((self.batch, *self._sig[t][1]), self._sig[t][0])
+               for t in self.tops}
+        for fi in np.unique(fis):
+            m = fis == fi
+            arrays = self._file_arrays(int(fi))
             for t in self.tops:
-                out[t].append(arrays[t][row])
-        return {t: np.stack(v) for t, v in out.items()}
+                out[t][m] = arrays[t][rows[m]]
+        return out
 
     def close(self) -> None:
         self._cache.clear()

@@ -67,14 +67,19 @@ def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
 
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
-                   axis_name: str, causal: bool = False) -> jnp.ndarray:
+                   axis_name: str, causal: bool = False,
+                   valid_len: int | None = None) -> jnp.ndarray:
     """Sequence-parallel attention inside shard_map.
 
     q,k,v: the LOCAL sequence shard (B, S/n, H, D) on each device of the
     `axis_name` mesh axis. Returns the local output shard. K/V blocks make
     one full trip around the ring (n-1 ppermutes), overlapping compute with
     neighbor transfers — the TPU-native equivalent of all-gather-free
-    context parallelism."""
+    context parallelism.
+
+    valid_len: global key positions >= valid_len are padding (the top-level
+    wrapper pads uneven sequence lengths up to a multiple of the ring
+    size); they are masked out of every block."""
     n_dev = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     scale = 1.0 / math.sqrt(q.shape[-1])
@@ -85,11 +90,15 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
         out, m, l, kk, vv = carry
         src_idx = (my_idx + i) % n_dev
         mask = None
+        a = jnp.arange(block_len)[:, None]
+        bcol = jnp.arange(block_len)[None, :]
         if causal:
-            a = jnp.arange(block_len)[:, None]
-            bcol = jnp.arange(block_len)[None, :]
             mask = ((my_idx * block_len + a) >= (src_idx * block_len + bcol))
-            mask = mask[None, None]
+        if valid_len is not None:
+            key_ok = (src_idx * block_len + bcol) < valid_len
+            mask = key_ok if mask is None else (mask & key_ok)
+        if mask is not None:
+            mask = jnp.broadcast_to(mask, (block_len, block_len))[None, None]
         blk_out, blk_m, blk_l = _block_attn(q, kk, vv, scale=scale, mask=mask)
         # online-softmax merge of (out, m, l) with the new block
         new_m = jnp.maximum(m, blk_m)
@@ -123,13 +132,29 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
 def sequence_parallel_attention(q, k, v, mesh, *, seq_axis: str = "model",
                                 causal: bool = False):
     """Top-level entry: q,k,v (B,S,H,D) global arrays; shards S over
-    `seq_axis` and runs ring attention under shard_map."""
+    `seq_axis` and runs ring attention under shard_map.
+
+    Uneven sequence lengths are handled by padding S up to a multiple of
+    the ring size and masking the padded key positions in every block;
+    the pad rows are sliced off the output."""
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
+    n = mesh.shape[seq_axis]
+    s = q.shape[1]
+    pad = (-s) % n
+    valid_len = s if pad else None
+    if pad:
+        widths = ((0, 0), (0, pad), (0, 0), (0, 0))
+        q = jnp.pad(q, widths)
+        k = jnp.pad(k, widths)
+        v = jnp.pad(v, widths)
+
     spec = P(None, seq_axis, None, None)
     fn = shard_map(
-        functools.partial(ring_attention, axis_name=seq_axis, causal=causal),
+        functools.partial(ring_attention, axis_name=seq_axis, causal=causal,
+                          valid_len=valid_len),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
     )
-    return fn(q, k, v)
+    out = fn(q, k, v)
+    return out[:, :s] if pad else out

@@ -149,6 +149,18 @@ class MeshPlan:
                             spec += [None] * (arr.ndim - len(spec))
                         placed[pname] = jax.device_put(
                             arr, NamedSharding(self.mesh, P(*spec)))
+                    elif (rule is not None and pname == "bias"
+                          and arr.ndim >= 1
+                          and (rule == "rows" or (tuple(rule) + (None,))[0]
+                               == "model")):
+                        # output-dim-sharded weight => the per-output bias
+                        # shards the same way (InnerProduct (out,in) and
+                        # Convolution (Cout,Cin/g,kh,kw) both carry the
+                        # output dim first)
+                        placed[pname] = jax.device_put(
+                            arr, NamedSharding(self.mesh,
+                                               P(*(["model"]
+                                                   + [None] * (arr.ndim - 1)))))
                     else:
                         placed[pname] = jax.device_put(arr, self.replicated())
                 out[lname] = placed

@@ -470,18 +470,28 @@ class Solver:
         the TP rules), read per-shard."""
         import orbax.checkpoint as ocp
 
+        # every leaf gets an explicit CURRENT-topology sharding: letting
+        # orbax fall back to the sharding recorded in the file would pin
+        # the restore to the checkpoint's topology
+        if self.mesh is not None:
+            default_sharding = self.mesh.replicated()
+        else:
+            from jax.sharding import SingleDeviceSharding
+            default_sharding = SingleDeviceSharding(jax.devices()[0])
+
         def abstract(tree):
             return jax.tree.map(
                 lambda a: jax.ShapeDtypeStruct(
                     np.shape(a), a.dtype,
-                    sharding=getattr(a, "sharding", None))
+                    sharding=getattr(a, "sharding", default_sharding))
                 if hasattr(a, "dtype") else a, tree)
 
         target = {
             "params": abstract(self.params),
             "opt_state": abstract(self.opt_state),
             "net_state": abstract(self.net_state),
-            "iter": jax.ShapeDtypeStruct((), jnp.int32),
+            "iter": jax.ShapeDtypeStruct((), jnp.int32,
+                                         sharding=default_sharding),
         }
         with ocp.StandardCheckpointer() as ckptr:
             state = ckptr.restore(os.path.abspath(path), target)

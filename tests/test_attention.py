@@ -69,6 +69,53 @@ class TestRingAttention:
         np.testing.assert_allclose(np.array(out), np.array(ref), rtol=2e-4,
                                    atol=1e-5)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_bigger_shapes(self, rng, causal):
+        """Non-toy sizes: S=256 over 8 devices (32/shard), 8 heads, d=32."""
+        plan = MeshPlan.data_parallel()
+        q, k, v = qkv(rng, b=2, s=256, h=8, d=32)
+        ref = attention(q, k, v, causal=causal)
+        out = sequence_parallel_attention(q, k, v, plan.mesh,
+                                          seq_axis="data", causal=causal)
+        np.testing.assert_allclose(np.array(out), np.array(ref), rtol=2e-3,
+                                   atol=1e-5)
+
+    @pytest.mark.parametrize("s", [13, 27, 63])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_uneven_sequence_shards(self, rng, s, causal):
+        """S not divisible by the ring size: padded up, pad keys masked in
+        every block, output sliced back — results identical to the
+        single-device reference."""
+        plan = MeshPlan.data_parallel()  # 8 devices; 13/27/63 all uneven
+        q, k, v = qkv(rng, b=2, s=s, h=2, d=8)
+        ref = attention(q, k, v, causal=causal)
+        out = sequence_parallel_attention(q, k, v, plan.mesh,
+                                          seq_axis="data", causal=causal)
+        assert out.shape == q.shape
+        np.testing.assert_allclose(np.array(out), np.array(ref), rtol=2e-4,
+                                   atol=1e-5)
+
+    def test_mixed_causal_and_not_same_program(self, rng):
+        """Both mask modes through the same jitted caller (mode is a
+        static argument; both variants must trace and agree)."""
+        plan = MeshPlan.data_parallel()
+        q, k, v = qkv(rng, b=1, s=24, h=2, d=8)
+
+        @jax.jit
+        def both(q, k, v):
+            a = sequence_parallel_attention(q, k, v, plan.mesh,
+                                            seq_axis="data", causal=False)
+            b = sequence_parallel_attention(q, k, v, plan.mesh,
+                                            seq_axis="data", causal=True)
+            return a, b
+        a, b = both(q, k, v)
+        np.testing.assert_allclose(np.array(a),
+                                   np.array(attention(q, k, v)),
+                                   rtol=2e-4, atol=1e-5)
+        np.testing.assert_allclose(np.array(b),
+                                   np.array(attention(q, k, v, causal=True)),
+                                   rtol=2e-4, atol=1e-5)
+
     def test_gradients_flow(self, rng):
         plan = MeshPlan.data_parallel()
         q, k, v = qkv(rng, b=1, s=16, h=2, d=4)

@@ -323,9 +323,9 @@ class HDF5Feeder:
             fi = order[pos]
             rows_in = within[m] - (cum[pos] - self.lengths[fi])
             if self.shuffle:
-                rows_in = np.asarray(
-                    [self._row_perm(int(ep), int(f))[r]
-                     for f, r in zip(fi, rows_in)])
+                for f in np.unique(fi):
+                    fm = fi == f
+                    rows_in[fm] = self._row_perm(int(ep), int(f))[rows_in[fm]]
             fis[m] = fi
             rows[m] = rows_in
         # one fancy-index COPY per spanned file (rows grouped by file):

@@ -48,15 +48,23 @@ def _block_attn(q, k, v, *, scale, mask=None):
 
 
 def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
-              causal: bool = False, use_flash: bool = False) -> jnp.ndarray:
+              causal: bool = False, use_flash: bool = False,
+              flash_interpret: bool | None = None) -> jnp.ndarray:
     """Single-device attention: q,k,v (B,S,H,D) -> (B,S,H,D).
 
-    use_flash: route through the Pallas flash-attention kernel
-    (ops/flash_attention.py) — O(S) memory VMEM-tiled online softmax;
-    forward-only, sequence lengths must tile evenly."""
+    use_flash: route through the Pallas flash-attention kernels
+    (ops/flash_attention.py) — O(S) memory VMEM-tiled online softmax,
+    differentiable (custom_vjp backward kernels); sequence lengths must
+    tile evenly. flash_interpret: None picks interpreter mode when the
+    process default backend is not TPU; pass an explicit bool when
+    executing somewhere other than the default backend (e.g. CPU-pinned
+    under a TPU-default process)."""
     if use_flash:
         from .flash_attention import flash_attention
-        return flash_attention(q, k, v, causal=causal)
+        if flash_interpret is None:
+            flash_interpret = jax.default_backend() != "tpu"
+        return flash_attention(q, k, v, causal=causal,
+                               interpret=flash_interpret)
     scale = 1.0 / math.sqrt(q.shape[-1])
     mask = None
     if causal:

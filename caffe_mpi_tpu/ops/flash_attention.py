@@ -1,18 +1,26 @@
-"""Pallas flash-attention forward kernel (TPU).
+"""Pallas flash-attention kernels (TPU): forward + backward.
 
 The Pallas path of the framework: where XLA's fusion isn't enough, ops drop
 to hand-written TPU kernels (the reference's analogue is its hand-written
 CUDA kernels next to cuDNN ops). Attention is the canonical case — naive
-attention materializes the (Sq, Sk) score matrix in HBM; this kernel keeps
+attention materializes the (Sq, Sk) score matrix in HBM; these kernels keep
 it in VMEM tiles with an online softmax, O(S) memory instead of O(S^2).
 
-Layout: (B, H, S, D) inside the kernel (sequence-minor tiles). The public
+Layout: (B, H, S, D) inside the kernels (sequence-minor tiles). The public
 entry accepts the framework's (B, S, H, D) and transposes at the edges.
-Grid: (B*H, Sq/BQ); the innermost K loop runs as a fori_loop over Sk/BK
-tiles within the kernel, accumulating (out, m, l) in VMEM scratch.
+Forward grid: (B*H, Sq/BQ) with an inner fori_loop over K tiles,
+accumulating (out, m, l) in registers; it also emits the per-row
+logsumexp, which the backward re-uses to recompute normalized
+probabilities tile-by-tile (FlashAttention-2 style) instead of storing P:
+  dQ kernel: grid (B*H, Sq/BQ), loops K tiles; dS = P * (dO V^T - D)
+  dK/dV kernel: grid (B*H, Sk/BK), loops Q tiles; dV += P^T dO,
+                dK += dS^T Q
+where D = rowsum(dO * O). Differentiation is wired through jax.custom_vjp,
+so `jax.grad` through `attention(use_flash=True)` hits these kernels.
 
-Used by ops.attention.attention when `use_flash=True` on TPU; the jnp
-implementation remains the reference and the CPU/interpret fallback.
+Used by ops.attention.attention when `use_flash=True`; the jnp
+implementation remains the numerical reference and the CPU fallback
+(interpret=True runs these same kernels in interpreter mode for tests).
 """
 
 from __future__ import annotations
@@ -28,7 +36,8 @@ BQ = 128  # query tile (MXU-aligned)
 BK = 128  # key tile
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, sk, bq, bk):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, sk,
+                bq, bk):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)  # (bq, d)
     n_k = sk // bk
@@ -68,38 +77,204 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, sk, bq, bk):
     else:
         n_iter = n_k
     out, m, l = jax.lax.fori_loop(0, n_iter, body, (out0, m0, l0))
-    o_ref[0] = (out / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (out / l_safe[:, None]).astype(o_ref.dtype)
+    # logsumexp per row; backward recomputes p = exp(s - lse). m is never
+    # -inf here (fully-masked blocks clamp blk_m to 0).
+    lse_ref[0] = (m + jnp.log(l_safe)).astype(lse_ref.dtype)
 
 
-def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
-                    causal: bool = False, interpret: bool = False
-                    ) -> jnp.ndarray:
-    """q,k,v: (B, S, H, D) -> (B, S, H, D). Forward only (inference path);
-    training uses the jnp reference whose VJP XLA handles."""
-    b, sq, h, d = q.shape
-    sk = k.shape[1]
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, scale, causal, sk, bq, bk):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0].astype(jnp.float32)       # (bq,)
+    delta = delta_ref[0].astype(jnp.float32)   # (bq,)
+    n_k = sk // bk
+
+    def body(j, dq):
+        k = k_ref[0, pl.dslice(j * bk, bk), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(j * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse[:, None])          # normalized probabilities
+        if causal:
+            rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            p = jnp.where(rows >= cols, p, 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    d = q_ref.shape[-1]
+    if causal:
+        n_iter = jnp.minimum((qi + 1) * bq + bk - 1, sk) // bk
+    else:
+        n_iter = n_k
+    dq = jax.lax.fori_loop(0, n_iter, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, sq, bq, bk):
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)   # (bk, d)
+    v = v_ref[0].astype(jnp.float32)
+    n_q = sq // bq
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.dslice(i * bq, bq), :].astype(jnp.float32)
+        do = do_ref[0, pl.dslice(i * bq, bq), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.dslice(i * bq, bq)].astype(jnp.float32)
+        delta = delta_ref[0, pl.dslice(i * bq, bq)].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse[:, None])          # (bq, bk)
+        if causal:
+            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            p = jnp.where(rows >= cols, p, 0.0)
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32) * scale
+        return dk, dv
+
+    d = k_ref.shape[-1]
+    if causal:
+        start = (ki * bk) // bq  # earlier Q tiles are fully masked
+    else:
+        start = 0
+    dk, dv = jax.lax.fori_loop(
+        start, n_q, body,
+        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _check_tiles(sq: int, sk: int) -> tuple[int, int]:
     bq = min(BQ, sq)
     bk = min(BK, sk)
     if sq % bq or sk % bk:
         raise ValueError(f"sequence lengths ({sq},{sk}) must be multiples "
                          f"of the tile sizes ({bq},{bk})")
-    scale = 1.0 / math.sqrt(d)
-    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    return bq, bk
 
-    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+
+def _fwd_impl(q, k, v, causal, interpret):
+    """(B*H, S, D) inputs -> (out, lse)."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq, bk = _check_tiles(sq, sk)
+    scale = 1.0 / math.sqrt(d)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                sk=sk, bq=bq, bk=bk)
-    out = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
-        grid=(b * h, sq // bq),
+        grid=(bh, sq // bq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, bq), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ],
         interpret=interpret,
-    )(qt, kt, vt)
+    )(q, k, v)
+
+
+def _bwd_impl(q, k, v, out, lse, do, causal, interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq, bk = _check_tiles(sq, sk)
+    scale = 1.0 / math.sqrt(d)
+    # D_i = rowsum(dO * O) — cheap elementwise+reduce; XLA fuses it
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          sk=sk, bq=bq, bk=bk),
+        grid=(bh, sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),   # q
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # k
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # v
+            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),   # do
+            pl.BlockSpec((1, bq), lambda i, j: (i, j)),         # lse
+            pl.BlockSpec((1, bq), lambda i, j: (i, j)),         # delta
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          sq=sq, bq=bq, bk=bk),
+        grid=(bh, sk // bk),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),   # q
+            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),   # k
+            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),   # v
+            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),   # do
+            pl.BlockSpec((1, sq), lambda i, j: (i, 0)),         # lse
+            pl.BlockSpec((1, sq), lambda i, j: (i, 0)),         # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal, interpret):
+    out, _ = _fwd_impl(q, k, v, causal, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, interpret):
+    out, lse = _fwd_impl(q, k, v, causal, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, interpret, res, do):
+    q, k, v, out, lse = res
+    return _bwd_impl(q, k, v, out, lse, do, causal, interpret)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                    causal: bool = False, interpret: bool = False
+                    ) -> jnp.ndarray:
+    """q,k,v: (B, S, H, D) -> (B, S, H, D). Differentiable: jax.grad hits
+    the Pallas backward kernels via custom_vjp."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    _check_tiles(sq, sk)
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    out = _flash(qt, kt, vt, causal, interpret)
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

@@ -151,8 +151,8 @@ class MeshPlan:
                             arr, NamedSharding(self.mesh, P(*spec)))
                     elif (rule is not None and pname == "bias"
                           and arr.ndim >= 1
-                          and (rule == "rows" or (tuple(rule) + (None,))[0]
-                               == "model")):
+                          and (rule == "rows"
+                               or (len(rule) > 0 and rule[0] == "model"))):
                         # output-dim-sharded weight => the per-output bias
                         # shards the same way (InnerProduct (out,in) and
                         # Convolution (Cout,Cin/g,kh,kw) both carry the

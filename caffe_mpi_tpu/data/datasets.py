@@ -165,6 +165,23 @@ class LMDBDataset:
             return parse_datum(txn.get(self.keys[index]))
 
 
+class LevelDBDataset:
+    """Reads LevelDB datasets written by the reference's convert tools
+    (db_leveldb.cpp) via the dependency-free SSTable reader
+    (data/leveldb_io.py): all tables merged, key order, Datum values."""
+
+    def __init__(self, path: str):
+        from .leveldb_io import LevelDBReader
+        self._reader = LevelDBReader(path)
+        self.keys = list(self._reader.keys())  # values decode on demand
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+    def get(self, index: int) -> tuple[np.ndarray, int]:
+        return parse_datum(self._reader.get(self.keys[index]))
+
+
 class ImageFolderDataset:
     """Reads an index file of `relative/path.jpg label` lines (the
     reference ImageData layer's source format, image_data_layer.cpp)."""
@@ -368,8 +385,5 @@ def open_dataset(backend: str, source: str, **kw) -> Dataset:
             pass
         return py
     if backend == "LEVELDB":
-        raise NotImplementedError(
-            "LevelDB backend needs the plyvel/leveldb module (not in this "
-            "image); convert with convert_imageset to LMDB or datumfile"
-        )
+        return LevelDBDataset(source)
     raise ValueError(f"unknown db backend {backend!r}")

@@ -173,13 +173,13 @@ class LevelDBDataset:
     def __init__(self, path: str):
         from .leveldb_io import LevelDBReader
         self._reader = LevelDBReader(path)
-        self.keys = list(self._reader.keys())  # values decode on demand
 
     def __len__(self) -> int:
-        return len(self.keys)
+        return len(self._reader)
 
     def get(self, index: int) -> tuple[np.ndarray, int]:
-        return parse_datum(self._reader.get(self.keys[index]))
+        # positional: values decode on demand from the mmap'd tables
+        return parse_datum(self._reader.value_at(index))
 
 
 class ImageFolderDataset:

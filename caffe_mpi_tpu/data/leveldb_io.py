@@ -48,6 +48,39 @@ class LevelDBError(RuntimeError):
     pass
 
 
+# -- CRC32C (Castagnoli) + leveldb's mask, table-based --------------------
+# leveldb verifies masked crc32c on every WAL record during recovery (and
+# on blocks when verify_checksums is set); files we write must carry the
+# real checksum or real leveldb silently drops the records as corrupt.
+
+def _crc32c_table():
+    poly = 0x82F63B78
+    table = []
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ poly if c & 1 else c >> 1
+        table.append(c)
+    return table
+
+
+_CRC32C_TABLE = _crc32c_table()
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    crc ^= 0xFFFFFFFF
+    for b in data:
+        crc = _CRC32C_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def masked_crc32c(data: bytes) -> int:
+    """leveldb's checksum masking (crc32c.h Mask): rotate right 15 and add
+    a constant, so CRCs of CRC-bearing data stay well-distributed."""
+    crc = crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
 # ---------------------------------------------------------------------------
 # varints + snappy
 # ---------------------------------------------------------------------------
@@ -230,10 +263,14 @@ def _wal_records(path: str):
         if block_left < 7:  # trailer padding
             pos += block_left
             continue
+        (crc,) = struct.unpack_from("<I", data, pos)
         length, rtype = struct.unpack_from("<HB", data, pos + 4)
         payload = data[pos + 7: pos + 7 + length]
         if rtype == 0 and length == 0:  # preallocated zero region: EOF
             break
+        if crc != masked_crc32c(bytes([rtype]) + payload):
+            raise LevelDBError(f"{path}: WAL record checksum mismatch "
+                               f"(corrupt log)")
         pos += 7 + length
         if rtype == 1:          # FULL
             yield payload
@@ -343,6 +380,11 @@ class LevelDBReader:
     def keys(self):
         return (k for k, _ in self._records)
 
+    def value_at(self, index: int) -> bytes:
+        """Positional access in key order — the datasets' hot path (no
+        per-record key bisect)."""
+        return self._value(self._records[index][1])
+
     def get(self, key: bytes):
         import bisect
         i = bisect.bisect_left(self._records, (key,),
@@ -398,8 +440,8 @@ class _BlockBuilder:
 def write_wal(path: str, items, start_seq: int = 1) -> None:
     """Write (key, value) pairs as one WriteBatch per record into a
     leveldb write-ahead log file — the shape of the unflushed tail a real
-    writer leaves behind."""
-    import zlib
+    writer leaves behind. Records carry real masked crc32c, so actual
+    leveldb recovery accepts them."""
     out = bytearray()
     for i, (key, value) in enumerate(items):
         batch = struct.pack("<QI", start_seq + i, 1)
@@ -417,7 +459,7 @@ def write_wal(path: str, items, start_seq: int = 1) -> None:
             rtype = (1 if pos == 0 and end == len(batch)
                      else 2 if pos == 0
                      else 4 if end == len(batch) else 3)
-            crc = zlib.crc32(bytes([rtype]) + chunk) & 0xFFFFFFFF
+            crc = masked_crc32c(bytes([rtype]) + chunk)
             out += struct.pack("<IHB", crc, len(chunk), rtype) + chunk
             pos = end
             if end == len(batch):
@@ -455,11 +497,10 @@ def write_leveldb(path: str, items, block_size: int = 4096,
             comp = 1
         else:
             comp = 0
-        import zlib
         table += block
-        # trailer: compression byte + crc32c (masked); readers here skip
-        # crc verification, real leveldb verifies only when asked
-        crc = zlib.crc32(block + bytes([comp])) & 0xFFFFFFFF
+        # trailer: compression byte + MASKED crc32c of block+type — the
+        # checksum real leveldb verifies under verify_checksums
+        crc = masked_crc32c(block + bytes([comp]))
         table += bytes([comp]) + struct.pack("<I", crc)
         return _put_uvarint(off) + _put_uvarint(len(block))
 

@@ -125,13 +125,10 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
 
     # mark the softmax stats as varying over the ring axis so the scan carry
     # types line up under shard_map's per-device type tracking
-    def _vary(x):
-        if hasattr(lax, "pcast"):
-            return lax.pcast(x, (axis_name,), to="varying")
-        return lax.pvary(x, (axis_name,))
+    from ..parallel.mesh import mark_varying
 
-    m0 = _vary(jnp.full((b, h, s), -jnp.inf, q.dtype))
-    l0 = _vary(jnp.zeros((b, h, s), q.dtype))
+    m0 = mark_varying(jnp.full((b, h, s), -jnp.inf, q.dtype), axis_name)
+    l0 = mark_varying(jnp.zeros((b, h, s), q.dtype), axis_name)
     (out, m, l, _, _), _ = lax.scan(step, (out0, m0, l0, k, v),
                                     jnp.arange(n_dev))
     return out / jnp.maximum(l, 1e-30)[..., None].swapaxes(1, 2)

@@ -1,0 +1,141 @@
+"""Mixture-of-Experts FFN with expert parallelism (EP).
+
+The reference has no MoE ops (SURVEY §2.7: EP absent); this is part of the
+beyond-reference distributed story (DP/TP: parallel/mesh.py, SP:
+ops/attention.py, PP: parallel/pipeline.py).
+
+TPU-native design — the GShard dispatch/combine formulation, which is the
+shape XLA's GSPMD partitioner understands natively:
+
+  router:   logits = x @ gate -> softmax -> top-k experts per token
+  capacity: each expert processes at most C tokens (C from
+            capacity_factor); overflow tokens are DROPPED from that
+            expert (their combine weight is zero) — the standard GShard
+            semantics that keeps every tensor static-shaped for XLA
+  dispatch: one-hot (T, E, C) tensor; expert inputs = einsum to (E, C, F)
+  experts:  per-expert 2-layer FFN as batched (E, ...) einsums — one MXU
+            matmul batched over experts, no Python loop
+  combine:  gate-weighted einsum back to (T, F)
+
+Expert parallelism = shard the E dimension (expert weights AND the
+(E, C, ...) activation tensors) over a mesh axis via sharding
+constraints; GSPMD then partitions the batched einsums per-expert and
+inserts the token all-to-alls that a hand-written EP backend (DeepSpeed /
+Tutel style) performs explicitly. No shard_map needed — this op composes
+with DP/TP sharding on the same mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def init_moe_params(key, d_model: int, d_hidden: int, n_experts: int,
+                    dtype=jnp.float32) -> dict:
+    kg, k1, k2 = jax.random.split(key, 3)
+    s1 = (2.0 / d_model) ** 0.5
+    s2 = (2.0 / d_hidden) ** 0.5
+    return {
+        "gate": jax.random.normal(kg, (d_model, n_experts), dtype) * 0.02,
+        "w1": jax.random.normal(k1, (n_experts, d_model, d_hidden),
+                                dtype) * s1,
+        "b1": jnp.zeros((n_experts, d_hidden), dtype),
+        "w2": jax.random.normal(k2, (n_experts, d_hidden, d_model),
+                                dtype) * s2,
+        "b2": jnp.zeros((n_experts, d_model), dtype),
+    }
+
+
+def shard_experts(params: dict, mesh, expert_axis: str = "model") -> dict:
+    """Place expert-major weights with dim 0 (E) sharded over the mesh
+    axis — each device holds n_experts / axis_size experts."""
+    def put(name, x):
+        if name == "gate":
+            return jax.device_put(x, NamedSharding(mesh, P()))
+        spec = [expert_axis] + [None] * (x.ndim - 1)
+        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+    return {k: put(k, v) for k, v in params.items()}
+
+
+def moe_ffn(params: dict, x: jnp.ndarray, *, top_k: int = 1,
+            capacity_factor: float = 2.0, mesh=None,
+            expert_axis: str = "model"):
+    """x: (T, F) tokens -> (T, F), plus aux load-balancing loss.
+
+    Returns (y, aux) where aux is the GShard auxiliary loss
+    (mean fraction-of-tokens * mean gate-probability per expert, scaled
+    by n_experts^2) — add it to the training loss to keep routing
+    balanced. With `mesh`, the expert dim of weights and dispatched
+    activations is constraint-sharded over `expert_axis` (EP)."""
+    t, f = x.shape
+    e = params["w1"].shape[0]
+    cap = max(int(capacity_factor * top_k * t / e), top_k)
+    cap = min(cap, t)
+
+    logits = x @ params["gate"]                      # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # top-k routing with per-expert position assignment
+    combine = jnp.zeros((t, e, cap), x.dtype)
+    mask_so_far = jnp.zeros((t, e), bool)
+    counts = jnp.zeros((e,), jnp.int32)
+    for _ in range(top_k):
+        masked = jnp.where(mask_so_far, -jnp.inf, logits)
+        choice = jnp.argmax(masked, axis=-1)          # (T,)
+        onehot = jax.nn.one_hot(choice, e, dtype=jnp.int32)
+        pos = counts[None, :] + jnp.cumsum(onehot, axis=0) - onehot  # (T,E)
+        keep = (onehot > 0) & (pos < cap)
+        gate_w = jnp.take_along_axis(probs, choice[:, None], axis=1)[:, 0]
+        combine = combine + (
+            keep[:, :, None]
+            * jax.nn.one_hot(pos, cap, dtype=x.dtype)
+            * gate_w[:, None, None])
+        counts = counts + jnp.sum(onehot * keep, axis=0)
+        mask_so_far = mask_so_far | (onehot > 0)
+
+    dispatch = (combine > 0).astype(x.dtype)          # (T, E, C)
+
+    def ep(v, spec):
+        if mesh is not None:
+            return jax.lax.with_sharding_constraint(
+                v, NamedSharding(mesh, P(*spec)))
+        return v
+
+    # dispatch tokens to experts: (E, C, F), sharded over experts
+    xe = ep(jnp.einsum("tec,tf->ecf", dispatch, x),
+            (expert_axis, None, None))
+    h = jax.nn.relu(jnp.einsum("ecf,efh->ech", xe, params["w1"])
+                    + params["b1"][:, None, :])
+    h = ep(h, (expert_axis, None, None))
+    ye = jnp.einsum("ech,ehf->ecf", h, params["w2"]) \
+        + params["b2"][:, None, :]
+    ye = ep(ye, (expert_axis, None, None))
+    y = jnp.einsum("tec,ecf->tf", combine, ye)        # back to tokens
+
+    # GShard aux loss: encourages uniform routing
+    frac_tokens = jnp.mean((dispatch.sum(2) > 0).astype(jnp.float32), axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = jnp.sum(frac_tokens * frac_probs) * e
+    return y, aux
+
+
+def moe_ffn_dense_reference(params: dict, x: jnp.ndarray, *,
+                            top_k: int = 1) -> jnp.ndarray:
+    """Unbatched per-expert loop, no capacity limit — the numerical oracle
+    for tests (matches moe_ffn when no tokens overflow)."""
+    logits = x @ params["gate"]
+    probs = jax.nn.softmax(logits, axis=-1)
+    e = params["w1"].shape[0]
+    _, topi = jax.lax.top_k(logits, top_k)
+    y = jnp.zeros_like(x)
+    for k in range(top_k):
+        idx = topi[:, k]
+        gate_w = jnp.take_along_axis(probs, idx[:, None], axis=1)[:, 0]
+        for ei in range(e):
+            sel = idx == ei
+            h = jax.nn.relu(x @ params["w1"][ei] + params["b1"][ei])
+            out = h @ params["w2"][ei] + params["b2"][ei]
+            y = y + jnp.where(sel[:, None], out * gate_w[:, None], 0.0)
+    return y

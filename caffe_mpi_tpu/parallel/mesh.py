@@ -39,6 +39,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 log = logging.getLogger("caffe_mpi_tpu.parallel")
 
 
+def mark_varying(x, axis_name: str):
+    """Mark a value as varying over a mesh axis (shard_map per-device type
+    tracking). Shim over the in-flux pcast/pvary jax API — the single
+    definition used by ring attention and the pipeline schedule."""
+    from jax import lax
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, (axis_name,), to="varying")
+    return lax.pvary(x, (axis_name,))
+
+
 def init_distributed(coordinator: str | None = None, num_processes: int | None = None,
                      process_id: int | None = None) -> None:
     """Multi-host init (reference Clusters::Init / MPI_Init,

@@ -1,0 +1,130 @@
+"""Pipeline parallelism: SPMD shift-register over a 'stage' mesh axis.
+
+The reference has NO pipeline parallelism (SURVEY §2.7: ForwardFromTo is a
+sequential loop on one device, net.cpp:669-682); this module is part of the
+beyond-reference distributed story (DP: mesh.py; TP: mesh.py sharding
+rules; SP: ops/attention.py; EP: ops/moe.py).
+
+TPU-native design — the canonical GPipe-on-SPMD pattern (the
+"jax-ml.github.io/scaling-book" pipelining recipe): stages must be
+STRUCTURALLY IDENTICAL (a stack of repeated blocks — the transformer /
+deep-MLP case where PP pays off). Stage s's params live on mesh position s
+of the stage axis: the stacked param pytree has a leading n_stages dim
+sharded over that axis, so each device holds exactly ONE stage's weights —
+the model memory is truly partitioned, which is the entire point of PP.
+
+Execution is a shift register under shard_map: at tick t every device
+applies its stage to the activation it holds, then `ppermute`s the result
+to the next device in the ring, while device 0 injects microbatch t and
+device S-1 emits a finished microbatch. n_micro + n_stages - 1 ticks
+drain the pipe; the (S-1)-tick bubble amortizes as n_micro grows. The
+ppermute traffic is neighbor-only, so it rides the ICI ring, and XLA's
+latency-hiding scheduler overlaps the transfer of tick t with the compute
+of tick t+1 — the overlap the reference builds with threads, done by the
+compiler.
+
+Differentiation: plain jax.grad through the scan — AD reverses the
+ppermute ring automatically, producing the reverse-direction gradient
+pipeline without any hand-written backward schedule.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import mark_varying
+
+
+def stack_stage_params(per_stage_params: list):
+    """[stage0_tree, stage1_tree, ...] -> one tree with leading stage dim.
+    Every stage must have congruent treedef/shapes (structural identity)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def shard_stages(stacked_params, mesh, stage_axis: str = "model"):
+    """Place the stacked params with the leading (stage) dim sharded over
+    the stage axis — one stage per mesh position, model memory 1/S per
+    device."""
+    def put(x):
+        spec = [stage_axis] + [None] * (x.ndim - 1)
+        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+    return jax.tree.map(put, stacked_params)
+
+
+def pipeline_apply(stage_fn, stacked_params, microbatches, mesh, *,
+                   stage_axis: str = "model"):
+    """Run a homogeneous stage stack as a pipelined SPMD program.
+
+    stage_fn(stage_params, x) -> y        one stage, pure
+    stacked_params                        leading dim = n_stages (sharded
+                                          or not; sharding constraint is
+                                          applied here)
+    microbatches: (n_micro, ...)          microbatch-major input
+    Returns (n_micro, ...) outputs equal to applying the stages
+    sequentially to each microbatch.
+    """
+    n_stages = mesh.shape[stage_axis]
+    lead = jax.tree.leaves(stacked_params)[0].shape[0]
+    if lead != n_stages:
+        raise ValueError(
+            f"stacked params have {lead} stages but the '{stage_axis}' "
+            f"mesh axis has {n_stages} positions")
+    n_micro = microbatches.shape[0]
+    if n_micro < 1:
+        raise ValueError("need at least one microbatch")
+
+    param_specs = jax.tree.map(
+        lambda x: P(*([stage_axis] + [None] * (x.ndim - 1))), stacked_params)
+
+    def spmd(params, mb):
+        # params: this device's stage (leading dim 1) — unstack it
+        p = jax.tree.map(lambda x: x[0], params)
+        idx = lax.axis_index(stage_axis)
+        is_first = idx == 0
+        is_last = idx == n_stages - 1
+        perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+
+        mb = mark_varying(mb, stage_axis)
+        state0 = jnp.zeros_like(mb[0])
+        out0 = mark_varying(jnp.zeros((n_micro, *mb.shape[1:]), mb.dtype),
+                            stage_axis)
+
+        def tick(carry, t):
+            state, outs = carry
+            # device 0 injects microbatch t (zeros once the input drains)
+            inject = jnp.where(t < n_micro, mb[jnp.minimum(t, n_micro - 1)],
+                               jnp.zeros_like(state))
+            x = jnp.where(is_first, inject, state)
+            y = stage_fn(p, x)
+            # device S-1 finished microbatch t-(S-1) at this tick
+            done_t = t - (n_stages - 1)
+            outs = jnp.where(
+                is_last & (done_t >= 0),
+                lax.dynamic_update_index_in_dim(
+                    outs, y, jnp.maximum(done_t, 0), 0),
+                outs)
+            # shift register: everyone hands its activation to stage+1
+            state = lax.ppermute(y, stage_axis, perm)
+            return (state, outs), None
+
+        n_ticks = n_micro + n_stages - 1
+        (_, outs), _ = lax.scan(tick, (state0, out0), jnp.arange(n_ticks))
+        # only the last stage holds real outputs; zero the rest and psum
+        # to replicate them across the stage axis
+        outs = jnp.where(is_last, outs, 0)
+        return lax.psum(outs, stage_axis)
+
+    from jax import shard_map
+    fn = shard_map(
+        spmd, mesh=mesh,
+        in_specs=(param_specs, P()),      # microbatches replicated in
+        out_specs=P(),                    # outputs replicated back
+    )
+    return fn(stacked_params, microbatches)
+
+

@@ -352,6 +352,18 @@ class TestLevelDB:
         r = LevelDBReader(path)
         assert dict(r.items()) == {b"a": b"new", b"b": b"keep"}
 
+    def test_crc32c_known_answers(self):
+        """crc32c + leveldb mask against published test vectors (rfc3720 /
+        leveldb crc32c_test.cc)."""
+        from caffe_mpi_tpu.data.leveldb_io import crc32c, masked_crc32c
+        assert crc32c(b"123456789") == 0xE3069283      # rfc3720 check value
+        assert crc32c(b"\x00" * 32) == 0x8A9136AA      # crc32c_test.cc
+        assert crc32c(b"\xff" * 32) == 0x62A8AB43
+        # mask formula: rot15 + constant
+        c = crc32c(b"foo")
+        assert masked_crc32c(b"foo") == (
+            (((c >> 15) | (c << 17)) + 0xA282EAD8) & 0xFFFFFFFF)
+
     def test_wal_tail_replayed(self, tmp_path):
         """Real leveldb keeps the newest records ONLY in the NNNNNN.log
         write-ahead file until a memtable flush; the reader must replay it

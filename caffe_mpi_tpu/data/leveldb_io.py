@@ -53,25 +53,53 @@ class LevelDBError(RuntimeError):
 # on blocks when verify_checksums is set); files we write must carry the
 # real checksum or real leveldb silently drops the records as corrupt.
 
-def _crc32c_table():
+def _crc32c_tables(n=8):
+    """Slice-by-N tables: table[0] is the classic byte table; table[k]
+    extends it so N input bytes fold into the CRC per Python-loop
+    iteration (~Nx the throughput of the per-byte loop)."""
     poly = 0x82F63B78
-    table = []
+    t0 = []
     for i in range(256):
         c = i
         for _ in range(8):
             c = (c >> 1) ^ poly if c & 1 else c >> 1
-        table.append(c)
-    return table
+        t0.append(c)
+    tables = [t0]
+    for k in range(1, n):
+        prev = tables[k - 1]
+        tables.append([(prev[i] >> 8) ^ t0[prev[i] & 0xFF]
+                       for i in range(256)])
+    return tables
 
 
-_CRC32C_TABLE = _crc32c_table()
+_CRC32C_TABLES = _crc32c_tables()
+_T = _CRC32C_TABLES
 
 
-def crc32c(data: bytes, crc: int = 0) -> int:
-    crc ^= 0xFFFFFFFF
-    for b in data:
-        crc = _CRC32C_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+try:  # hardware-accelerated when available (GB/s vs the MB/s table loop)
+    from google_crc32c import value as _crc32c_native
+except ImportError:
+    _crc32c_native = None
+
+
+def _crc32c_py(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    n8 = len(data) - (len(data) % 8)
+    for i in range(0, n8, 8):
+        crc ^= int.from_bytes(data[i:i + 4], "little")
+        crc = (_T[7][crc & 0xFF] ^ _T[6][(crc >> 8) & 0xFF]
+               ^ _T[5][(crc >> 16) & 0xFF] ^ _T[4][crc >> 24]
+               ^ _T[3][data[i + 4]] ^ _T[2][data[i + 5]]
+               ^ _T[1][data[i + 6]] ^ _T[0][data[i + 7]])
+    for i in range(n8, len(data)):
+        crc = _T[0][(crc ^ data[i]) & 0xFF] ^ (crc >> 8)
     return crc ^ 0xFFFFFFFF
+
+
+def crc32c(data: bytes) -> int:
+    if _crc32c_native is not None:
+        return _crc32c_native(data)
+    return _crc32c_py(data)
 
 
 def masked_crc32c(data: bytes) -> int:
@@ -268,9 +296,11 @@ def _wal_records(path: str):
         payload = data[pos + 7: pos + 7 + length]
         if rtype == 0 and length == 0:  # preallocated zero region: EOF
             break
-        if crc != masked_crc32c(bytes([rtype]) + payload):
-            raise LevelDBError(f"{path}: WAL record checksum mismatch "
-                               f"(corrupt log)")
+        if (len(payload) < length
+                or crc != masked_crc32c(bytes([rtype]) + payload)):
+            # torn/corrupt tail (writer crashed mid-append): real leveldb
+            # recovery keeps the valid prefix and stops here — so do we
+            break
         pos += 7 + length
         if rtype == 1:          # FULL
             yield payload

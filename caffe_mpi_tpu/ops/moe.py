@@ -64,10 +64,9 @@ def moe_ffn(params: dict, x: jnp.ndarray, *, top_k: int = 1,
             expert_axis: str = "model"):
     """x: (T, F) tokens -> (T, F), plus aux load-balancing loss.
 
-    Returns (y, aux) where aux is the GShard auxiliary loss
-    (mean fraction-of-tokens * mean gate-probability per expert, scaled
-    by n_experts^2) — add it to the training loss to keep routing
-    balanced. With `mesh`, the expert dim of weights and dispatched
+    Returns (y, aux) where aux is the Switch/GShard auxiliary loss
+    n_experts * sum_e(frac_tokens_e * mean_prob_e) — add it (scaled by a
+    small coefficient) to the training loss to keep routing balanced. With `mesh`, the expert dim of weights and dispatched
     activations is constraint-sharded over `expert_axis` (EP)."""
     t, f = x.shape
     e = params["w1"].shape[0]

@@ -30,8 +30,6 @@ pipeline without any hand-written backward schedule.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax import lax

@@ -369,6 +369,140 @@ def alexnet_bn(batch=256):
     return n
 
 
+def alexnet_owt(batch=256):
+    """AlexNet "One Weird Trick" variant (reference models/alexnet_owt):
+    single-tower — no LRN, no grouped convolutions; otherwise the
+    bvlc_alexnet channel plan."""
+    n = NetSpec("AlexNet-OWT")
+    n.data, n.label = L.Input(ntop=2, input_param=dict(
+        shape=[dict(dim=[batch, 3, 227, 227]), dict(dim=[batch])]))
+    n.conv1, n.relu1 = conv_relu(n.data, 96, 11, stride=4)
+    n.pool1 = L.Pooling(n.relu1, pool="MAX", kernel_size=3, stride=2)
+    n.conv2, n.relu2 = conv_relu(n.pool1, 256, 5, pad=2)
+    n.pool2 = L.Pooling(n.relu2, pool="MAX", kernel_size=3, stride=2)
+    n.conv3, n.relu3 = conv_relu(n.pool2, 384, 3, pad=1)
+    n.conv4, n.relu4 = conv_relu(n.relu3, 384, 3, pad=1)
+    n.conv5, n.relu5 = conv_relu(n.relu4, 256, 3, pad=1)
+    n.pool5 = L.Pooling(n.relu5, pool="MAX", kernel_size=3, stride=2)
+    n.fc6 = L.InnerProduct(n.pool5, num_output=4096,
+                           weight_filler=dict(type="gaussian", std=0.005),
+                           bias_filler=dict(type="constant", value=0.1))
+    n.relu6 = L.ReLU(n.fc6, in_place=True)
+    n.drop6 = L.Dropout(n.fc6, dropout_ratio=0.5, in_place=True)
+    n.fc7 = L.InnerProduct(n.fc6, num_output=4096,
+                           weight_filler=dict(type="gaussian", std=0.005),
+                           bias_filler=dict(type="constant", value=0.1))
+    n.relu7 = L.ReLU(n.fc7, in_place=True)
+    n.drop7 = L.Dropout(n.fc7, dropout_ratio=0.5, in_place=True)
+    n.fc8 = L.InnerProduct(n.fc7, num_output=1000,
+                           weight_filler=dict(type="gaussian", std=0.01),
+                           bias_filler=dict(type="constant"))
+    train_test_tail(n, n.fc8)
+    return n
+
+
+def inception_v2(batch=32):
+    """Inception-v2 / BN-GoogLeNet (reference models/inception_v2/
+    train_val.prototxt): GoogLeNet shape with BatchNorm (separate /bn top,
+    fused scale+bias, eps 1e-4, maf 0.9) after every conv, the 5x5 branch
+    conv named '5x5b', stride-2 reduction blocks 3c/4e (no 1x1 branch,
+    MAX pool, no pool_proj), 5b's block pool is MAX, aux heads after
+    3c and 4e at loss_weight 0.3. Reference layer names throughout so
+    reference .caffemodel weights load by name."""
+    n = NetSpec("Inception_v2")
+    n.data, n.label = L.Input(ntop=2, input_param=dict(
+        shape=[dict(dim=[batch, 3, 224, 224]), dict(dim=[batch])]))
+
+    def cbr(name, b, nout, ks, stride=1, pad=0):
+        bn = conv_bn_relu(n, name, b, nout, ks, stride=stride, pad_h=pad,
+                          relu=False)
+        r = L.ReLU(bn, in_place=True)
+        setattr(n, f"{name}/bn/relu", r)
+        return r
+
+    def block(name, bottom, o1, o3r, o3, o5r, o5, op, pool="AVE"):
+        c1 = cbr(f"{name}/1x1", bottom, o1, 1)
+        c3r = cbr(f"{name}/3x3_reduce", bottom, o3r, 1)
+        c3 = cbr(f"{name}/3x3", c3r, o3, 3, pad=1)
+        c5r = cbr(f"{name}/5x5_reduce", bottom, o5r, 1)
+        c5 = cbr(f"{name}/5x5b", c5r, o5, 5, pad=2)
+        p = L.Pooling(bottom, pool=pool, kernel_size=3, stride=1, pad=1)
+        setattr(n, f"{name}/pool", p)
+        cp = cbr(f"{name}/pool_proj", p, op, 1)
+        out = L.Concat(c1, c3, c5, cp)
+        setattr(n, f"{name}/output", out)
+        return out
+
+    def reduce_block(name, bottom, o3r, o3, o5r, o5):
+        """Stride-2 grid reduction: 3x3 and 5x5b branches at stride 2 +
+        a MAX-pool passthrough; no 1x1/pool_proj branches."""
+        c3r = cbr(f"{name}/3x3_reduce", bottom, o3r, 1)
+        c3 = cbr(f"{name}/3x3", c3r, o3, 3, stride=2, pad=1)
+        c5r = cbr(f"{name}/5x5_reduce", bottom, o5r, 1)
+        c5 = cbr(f"{name}/5x5b", c5r, o5, 5, stride=2, pad=2)
+        p = L.Pooling(bottom, pool="MAX", kernel_size=3, stride=2)
+        setattr(n, f"{name}/pool", p)
+        out = L.Concat(c3, c5, p)
+        setattr(n, f"{name}/output", out)
+        return out
+
+    def aux_head(prefix, pool_name, bottom):
+        p = L.Pooling(bottom, pool="AVE", kernel_size=5, stride=3)
+        setattr(n, pool_name, p)
+        c = cbr(f"{prefix}/conv", p, 128, 1)
+        fc = L.InnerProduct(c, num_output=1024,
+                            weight_filler=dict(type="xavier"),
+                            bias_filler=dict(type="constant"))
+        setattr(n, f"{prefix}/fc", fc)
+        setattr(n, f"{prefix}/fc/relu", L.ReLU(fc, in_place=True))
+        cls = L.InnerProduct(fc, num_output=1000,
+                             weight_filler=dict(type="xavier"),
+                             bias_filler=dict(type="constant"))
+        setattr(n, f"{prefix}/classifier", cls)
+        setattr(n, f"{prefix}/loss", L.SoftmaxWithLoss(
+            cls, n.label, loss_weight=0.3, include=dict(phase="TRAIN")))
+        prob = L.Softmax(cls, include=dict(phase="TEST"))
+        setattr(n, f"{prefix}/prob", prob)
+        setattr(n, f"{prefix}/top-1", L.Accuracy(
+            prob, n.label, include=dict(phase="TEST")))
+        setattr(n, f"{prefix}/top-5", L.Accuracy(
+            prob, n.label, top_k=5, include=dict(phase="TEST")))
+
+    x = cbr("conv1/7x7_s2", n.data, 64, 7, stride=2, pad=3)
+    p1 = L.Pooling(x, pool="MAX", kernel_size=3, stride=2)
+    setattr(n, "pool1/3x3_s2", p1)
+    x = cbr("conv2/3x3_reduce", p1, 64, 1)
+    x = cbr("conv2/3x3", x, 192, 3, pad=1)
+    p2 = L.Pooling(x, pool="MAX", kernel_size=3, stride=2)
+    setattr(n, "pool2/3x3_s2", p2)
+
+    x = block("inception_3a", p2, 64, 64, 64, 64, 96, 32)
+    x = block("inception_3b", x, 64, 64, 96, 64, 96, 64)
+    x = reduce_block("inception_3c", x, 128, 160, 64, 96)
+    aux_head("loss1", "pool3/5x5_s3", x)
+    x = block("inception_4a", x, 224, 64, 96, 96, 128, 128)
+    x = block("inception_4b", x, 192, 96, 128, 96, 128, 128)
+    x = block("inception_4c", x, 160, 128, 160, 128, 160, 96)
+    x = block("inception_4d", x, 96, 128, 192, 160, 192, 96)
+    x = reduce_block("inception_4e", x, 128, 192, 192, 256)
+    aux_head("loss2", "pool4/5x5_s3", x)
+    x = block("inception_5a", x, 352, 192, 320, 160, 224, 128)
+    x = block("inception_5b", x, 352, 192, 320, 192, 224, 128, pool="MAX")
+
+    p5 = L.Pooling(x, pool="AVE", kernel_size=7, stride=1)
+    setattr(n, "pool5/7x7_s1", p5)
+    cls = L.InnerProduct(p5, num_output=1000,
+                         weight_filler=dict(type="xavier"),
+                         bias_filler=dict(type="constant"))
+    setattr(n, "loss3/classifier", cls)
+    n.loss = L.SoftmaxWithLoss(cls, n.label)
+    setattr(n, "accuracy/top-1", L.Accuracy(cls, n.label,
+                                            include=dict(phase="TEST")))
+    setattr(n, "accuracy/top-5", L.Accuracy(cls, n.label, top_k=5,
+                                            include=dict(phase="TEST")))
+    return n
+
+
 def inception_v3(batch=32):
     """Inception v3, faithful to reference models/inception_v3/train_val
     .prototxt: its NVCaffe stem (conv4=80 3x3, conv5=192 3x3/s2, conv6=288,
@@ -746,6 +880,38 @@ weight_decay: 0.0001
 snapshot: 20000
 snapshot_prefix: "models/inception_v3/inception_v3"
 """,
+    "alexnet_owt": """# AlexNet-OWT solver (reference models/alexnet_owt/solver.prototxt:
+# poly power 2, base_lr 0.02 for B=1024, 100 epochs)
+net: "models/alexnet_owt/train_val.prototxt"
+test_iter: 195
+test_interval: 5000
+test_initialization: false
+display: 100
+max_iter: 125000
+base_lr: 0.02
+lr_policy: "poly"
+power: 2.0
+momentum: 0.9
+weight_decay: 0.0005
+snapshot: 500000
+snapshot_prefix: "models/alexnet_owt/alexnet_owt"
+""",
+    "inception_v2": """# Inception-v2 solver (reference models/inception_v2/solver.prototxt:
+# poly power 2; B=256 variant uses base_lr 0.2, max_iter 300000)
+net: "models/inception_v2/train_val.prototxt"
+test_iter: 1563
+test_interval: 20000
+test_initialization: false
+display: 100
+max_iter: 2400000
+base_lr: 0.05
+lr_policy: "poly"
+power: 2.0
+momentum: 0.9
+weight_decay: 0.0002
+snapshot: 20000
+snapshot_prefix: "models/inception_v2/inception_v2"
+""",
     "caffenet": """# CaffeNet solver (reference bvlc_reference_caffenet recipe)
 net: "models/caffenet/train_val.prototxt"
 test_iter: 1000
@@ -871,6 +1037,8 @@ def main():
     nets = {
         "alexnet": alexnet(),
         "alexnet_bn": alexnet_bn(),
+        "alexnet_owt": alexnet_owt(),
+        "inception_v2": inception_v2(),
         "caffenet": caffenet(),
         "cifar10_quick": cifar10_quick(),
         "googlenet": googlenet(),

@@ -19,6 +19,10 @@ CASES = [
     ("alexnet", f"{REF}/bvlc_alexnet/train_val.prototxt"),
     ("caffenet", f"{REF}/bvlc_reference_caffenet/train_val.prototxt"),
     ("vgg16", f"{REF}/vgg16/train_val.prototxt"),
+    ("alexnet_owt", f"{REF}/alexnet_owt/train_val.prototxt"),
+    ("inception_v2", f"{REF}/inception_v2/train_val.prototxt"),
+    ("alexnet_bn", f"{REF}/alexnet_bn/train_val.prototxt"),
+    ("cifar10_nv", f"{REF}/cifar10_nv/cifar10_nv_train_test.prototxt"),
 ]
 
 

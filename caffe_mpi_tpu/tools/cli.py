@@ -277,9 +277,14 @@ def cmd_time(args) -> int:
         rng_key = jax.random.PRNGKey(0)
 
         def f(p, s, fd):
-            _, _, loss = net.apply(p, s, fd, train=train,
-                                   rng=rng_key if train else None)
-            return loss
+            out_blobs, _, loss = net.apply(p, s, fd, train=train,
+                                           rng=rng_key if train else None)
+            if train:
+                return loss
+            # eval: force every terminal blob so XLA can't DCE the net
+            # when the TEST phase has no loss layer
+            return sum(jnp.sum(b.astype(jnp.float32)) for b in
+                       out_blobs.values() if hasattr(b, "ndim"))
         if train:
             g = jax.jit(jax.grad(f))
         else:
@@ -314,14 +319,40 @@ def cmd_time(args) -> int:
     else:
         fwd_ms = whole(False)
         total_ms = whole(True) if net.loss_blobs else float("nan")
-    print(f"{'layer':<28}{'type':<20}{'fwd ms':>12}{'bwd ms':>12}  (isolated)")
+    # analytic model FLOPs + MFU (utils/flops.py; the efficiency metric
+    # img/s can't express — how busy the MXU actually is)
+    from ..utils.flops import (layer_macs_per_image, net_macs_per_image,
+                               peak_flops, train_flops_per_image)
+    batch = next((net.blob_shapes[b][0] for b in net.feed_blobs), 1)
+    layer_gflops = {l.name: 2 * layer_macs_per_image(l) * batch / 1e9
+                    for l in net.layers}
+    print(f"{'layer':<28}{'type':<20}{'fwd ms':>12}{'bwd ms':>12}"
+          f"{'GFLOPs':>10}  (isolated)")
     for name, tname, fms, bms in rows:
         bs = f"{bms:.3f}" if bms == bms else "-"
-        print(f"{name:<28}{tname:<20}{fms:>12.3f}{bs:>12}")
+        gf = layer_gflops.get(name, 0.0)
+        gfs = f"{gf:.2f}" if gf else "-"
+        print(f"{name:<28}{tname:<20}{fms:>12.3f}{bs:>12}{gfs:>10}")
     print(f"\nwhole-graph forward (fused): {fwd_ms:.3f} ms")
     print(f"whole-graph forward+backward (fused): {total_ms:.3f} ms")
     print(f"sum of isolated per-layer fwd: {sum(r[2] for r in rows):.3f} ms "
           "(>= fused time; the gap is XLA fusion)")
+    fwd_gflops = 2 * net_macs_per_image(net) * batch / 1e9
+    print(f"model FLOPs: fwd {fwd_gflops:.2f} GFLOPs/batch "
+          f"(batch {batch}); fwd+bwd "
+          f"{train_flops_per_image(net) * batch / 1e9:.2f}")
+    dev = jax.devices()[0]
+    peak = peak_flops(dev)
+    if fwd_ms == fwd_ms and fwd_ms > 0:
+        achieved_f = fwd_gflops / fwd_ms  # GFLOP / ms = TFLOP/s
+        line = f"achieved: fwd {achieved_f:.2f} TFLOP/s"
+        if total_ms == total_ms and total_ms > 0:
+            achieved_t = train_flops_per_image(net) * batch / 1e9 / total_ms
+            line += f", fwd+bwd {achieved_t:.2f} TFLOP/s"
+            if peak:
+                line += (f"; MFU {achieved_t * 1e12 / peak:.1%} "
+                         f"({dev.device_kind} peak {peak / 1e12:.0f} TFLOP/s)")
+        print(line)
     return 0
 
 

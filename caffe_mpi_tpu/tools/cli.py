@@ -45,6 +45,8 @@ def _parser() -> argparse.ArgumentParser:
                                           "(xplane) to this directory")),
         ("max_iter", dict(type=int, default=0,
                           help="override solver max_iter (0 = prototxt)")),
+        ("test_iter", dict(type=int, default=0,
+                           help="override solver test_iter (0 = prototxt)")),
     ]:
         p.add_argument(f"-{flag}", f"--{flag}", **kw)
     return p
@@ -104,6 +106,8 @@ def cmd_train(args) -> int:
     sp = SolverParameter.from_file(args.solver)
     if args.max_iter:
         sp.max_iter = args.max_iter
+    if args.test_iter:
+        sp.test_iter = [args.test_iter] * max(len(sp.test_iter), 1)
     model_dir = os.path.dirname(os.path.abspath(args.solver)) \
         if not (sp.net and os.path.exists(sp.net)) else ""
     solver = Solver(sp, mesh=_select_mesh(args.gpu), model_dir=model_dir,

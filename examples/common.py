@@ -29,13 +29,17 @@ def synthetic_clusters(n: int, shape: tuple, seed: int, classes: int = 10,
 
 
 def run_example(here: str, artifacts: list[str], create_main,
-                real_marker: str, solver: str, argv=None) -> int:
+                real_marker: str, solver: str, argv=None,
+                synthetic_test_iter: int = 0) -> int:
     """Create missing dataset artifacts, then run `caffe train -solver ...`.
 
     artifacts: every file/dir the net prototxt needs (train+test DBs, mean
     file, ...) — creation re-runs unless ALL exist, so a partially-created
     dataset is repaired. real_marker: a file whose presence means the real
-    dataset is available (else --synthetic).
+    dataset is available (else --synthetic). synthetic_test_iter: when the
+    synthetic fallback is active, shrink the recipe's eval length to this
+    (a 1000-iter eval over a few hundred synthetic records just cycles the
+    tiny DB for no information).
     """
     sys.path.insert(0, _ROOT)
     p = argparse.ArgumentParser()
@@ -45,8 +49,8 @@ def run_example(here: str, artifacts: list[str], create_main,
                    help="forwarded to caffe train (e.g. 'all')")
     args = p.parse_args(argv)
 
+    have_real = os.path.exists(os.path.join(here, real_marker))
     if not all(os.path.exists(os.path.join(here, a)) for a in artifacts):
-        have_real = os.path.exists(os.path.join(here, real_marker))
         rc = create_main([] if have_real else ["--synthetic"])
         if rc:
             return rc
@@ -55,6 +59,8 @@ def run_example(here: str, artifacts: list[str], create_main,
     cli = ["train", "-solver", solver]
     if args.max_iter:
         cli += ["-max_iter", str(args.max_iter)]
+    if not have_real and synthetic_test_iter:
+        cli += ["-test_iter", str(synthetic_test_iter)]
     if args.gpu:
         cli += ["-gpu", args.gpu]
     os.chdir(_ROOT)  # solver paths are repo-relative, like the reference's

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Create ilsvrc12_{train,val}_lmdb + imagenet_mean.binaryproto.
+
+Mirrors the reference's examples/imagenet/create_imagenet.sh +
+make_imagenet_mean.sh: JPEG lists -> resized 256x256 Datum LMDBs -> mean
+image. With --synthetic, generates a separable 1000-class (well, --classes)
+256x256 task instead so the example runs without the dataset.
+
+Usage (real data):
+    python examples/imagenet/create_imagenet.py \
+        --train-root /path/ilsvrc12/train --train-list train.txt \
+        --val-root /path/ilsvrc12/val --val-list val.txt
+Usage (no data):
+    python examples/imagenet/create_imagenet.py --synthetic
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    here = os.path.dirname(os.path.abspath(__file__))
+    p.add_argument("--dir", default=here)
+    p.add_argument("--train-root", default=here,
+                   help="JPEG root for the train list")
+    p.add_argument("--train-list",
+                   default=os.path.join(here, "train.txt")
+                   if os.path.exists(os.path.join(here, "train.txt"))
+                   else "")
+    p.add_argument("--val-root", default=here)
+    p.add_argument("--val-list",
+                   default=os.path.join(here, "val.txt")
+                   if os.path.exists(os.path.join(here, "val.txt"))
+                   else "")
+    p.add_argument("--synthetic", action="store_true")
+    p.add_argument("--classes", type=int, default=10,
+                   help="synthetic-task classes")
+    p.add_argument("--train-n", type=int, default=512)
+    p.add_argument("--val-n", type=int, default=128)
+    args = p.parse_args(argv)
+
+    from caffe_mpi_tpu.data.datasets import encode_datum
+    from caffe_mpi_tpu.data.lmdb_io import write_lmdb
+    from caffe_mpi_tpu.tools.compute_image_mean import main as mean_main
+
+    if args.synthetic:
+        from examples.common import synthetic_clusters
+        for split, seed, n in (("train", 0, args.train_n),
+                               ("val", 1, args.val_n)):
+            # generate in chunks: at 3x256x256 a single 512-sample draw
+            # peaks at multiple GB of transient int arrays
+            def records():
+                chunk = 64
+                for base in range(0, n, chunk):
+                    m = min(chunk, n - base)
+                    imgs, labels = synthetic_clusters(
+                        m, (3, 256, 256), seed * 1000 + base, args.classes)
+                    for i in range(m):
+                        yield (f"{base + i:08d}".encode(),
+                               encode_datum(imgs[i], int(labels[i])))
+            db = os.path.join(args.dir, f"ilsvrc12_{split}_lmdb")
+            write_lmdb(db, records())
+            print(f"wrote {n} records to {db}")
+        mean_main([os.path.join(args.dir, "ilsvrc12_train_lmdb"),
+                   os.path.join(args.dir, "imagenet_mean.binaryproto")])
+        return 0
+    else:
+        from caffe_mpi_tpu.tools.convert_imageset import main as convert
+        if not (args.train_list and args.val_list):
+            print("need --train-list/--val-list (or --synthetic)",
+                  file=sys.stderr)
+            return 1
+        for split, root, lst in (("train", args.train_root, args.train_list),
+                                 ("val", args.val_root, args.val_list)):
+            db = os.path.join(args.dir, f"ilsvrc12_{split}_lmdb")
+            rc = convert(["-resize_height", "256", "-resize_width", "256",
+                          "-shuffle", root, lst, db])
+            if rc:
+                return rc
+        # dataset mean over the train split (make_imagenet_mean.sh ->
+        # the in-repo compute_image_mean tool)
+        mean_main([os.path.join(args.dir, "ilsvrc12_train_lmdb"),
+                   os.path.join(args.dir, "imagenet_mean.binaryproto")])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,31 @@
+#!/usr/bin/env python
+"""Train CaffeNet on ImageNet end-to-end: create the DBs if needed, run
+`caffe train` (mirrors the reference's examples/imagenet/train_caffenet.sh).
+Falls back to a synthetic 256x256 task when the JPEG lists are absent.
+
+Usage:
+    python examples/imagenet/run.py [-max_iter N] [-gpu all|id]
+"""
+
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.abspath(os.path.join(_HERE, "..", "..")))
+
+
+def main(argv=None) -> int:
+    from examples.common import run_example
+    from examples.imagenet.create_imagenet import main as create_main
+    return run_example(
+        _HERE,
+        artifacts=["ilsvrc12_train_lmdb", "ilsvrc12_val_lmdb",
+                   "imagenet_mean.binaryproto"],
+        create_main=create_main,
+        real_marker="train.txt",
+        solver="examples/imagenet/caffenet_solver.prototxt",
+        argv=argv, synthetic_test_iter=3)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,116 @@
+"""bench_data — host data-pipeline throughput (img/s per backend).
+
+The reference's pipeline perf story is DataReader/transformer thread
+counts auto-tuned to keep GPUs fed (data_layer.cpp:46-113). Here the
+host-side pipeline (dataset read -> decode -> transform -> batch) is the
+part that must outrun the TPU step; this tool measures it in isolation,
+per backend, with the same Feeder the training path uses.
+
+Usage:
+    python -m caffe_mpi_tpu.tools.bench_data [-n 4096] [-batch 256] \
+        [-shape 3x227x227] [-backends lmdb,leveldb,datumfile,hdf5]
+
+Prints one line per backend: img/s through Feeder + DataTransformer
+(crop+mirror+mean-subtract — the AlexNet training transform).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def _make_records(n, shape, seed=0):
+    rng = np.random.RandomState(seed)
+    imgs = rng.randint(0, 256, (n, *shape), dtype=np.uint8)
+    labels = rng.randint(0, 1000, n)
+    return imgs, labels
+
+
+def _feeder_for(backend, workdir, imgs, labels, batch, crop):
+    from ..data import DataTransformer, Feeder
+    from ..data.datasets import DatumFileDataset, encode_datum, open_dataset
+    from ..proto import TransformationParameter
+
+    n = len(labels)
+    recs = ((f"{i:08d}".encode(), encode_datum(imgs[i], int(labels[i])))
+            for i in range(n))
+    if backend == "lmdb":
+        from ..data.lmdb_io import write_lmdb
+        path = os.path.join(workdir, "b_lmdb")
+        write_lmdb(path, recs)
+        ds = open_dataset("LMDB", path)
+    elif backend == "leveldb":
+        from ..data.leveldb_io import write_leveldb
+        path = os.path.join(workdir, "b_leveldb")
+        write_leveldb(path, list(recs), compress=True)
+        ds = open_dataset("LEVELDB", path)
+    elif backend == "datumfile":
+        path = os.path.join(workdir, "b.datumdb")
+        DatumFileDataset.write(path, (r for _, r in recs))
+        ds = open_dataset("DATUMFILE", path)
+    elif backend == "hdf5":
+        import h5py
+        path = os.path.join(workdir, "b.h5")
+        with h5py.File(path, "w") as f:
+            f["data"] = imgs
+            f["label"] = labels.astype(np.int64)
+        src = os.path.join(workdir, "b_src.txt")
+        with open(src, "w") as f:
+            f.write(path + "\n")
+        from ..data.feeder import HDF5Feeder
+        from ..proto import NetParameter
+        lp = NetParameter.from_text(
+            'layer { name: "h" type: "HDF5Data" top: "data" top: "label"\n'
+            f'  hdf5_data_param {{ source: "{src}" batch_size: {batch} '
+            'shuffle: true } }').layer[0]
+        return HDF5Feeder(lp)
+    else:
+        raise ValueError(backend)
+    tp = TransformationParameter.from_text(
+        f"crop_size: {crop} mirror: true mean_value: 104 "
+        "mean_value: 117 mean_value: 123")
+    return Feeder(ds, DataTransformer(tp, "TRAIN"), batch_size=batch,
+                  shuffle=True)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="bench_data")
+    p.add_argument("-n", "--n", type=int, default=4096)
+    p.add_argument("-batch", "--batch", type=int, default=256)
+    p.add_argument("-shape", "--shape", default="3x256x256")
+    p.add_argument("-crop", "--crop", type=int, default=227)
+    p.add_argument("-backends", "--backends",
+                   default="lmdb,leveldb,datumfile,hdf5")
+    args = p.parse_args(argv)
+    shape = tuple(int(x) for x in args.shape.split("x"))
+
+    imgs, labels = _make_records(args.n, shape)
+    iters = max(args.n // args.batch, 1)
+    with tempfile.TemporaryDirectory() as workdir:
+        for backend in args.backends.split(","):
+            t_build = time.perf_counter()
+            feeder = _feeder_for(backend, workdir, imgs, labels,
+                                 args.batch, args.crop)
+            build_s = time.perf_counter() - t_build
+            feeder(0)  # warm caches / thread pools
+            t0 = time.perf_counter()
+            for it in range(1, iters + 1):
+                feeder(it)
+            dt = time.perf_counter() - t0
+            close = getattr(feeder, "close", None)
+            if close:
+                close()
+            print(f"{backend:>10}: {args.batch * iters / dt:8.0f} img/s "
+                  f"({args.batch}x{args.shape}, crop {args.crop}, "
+                  f"build {build_s:.1f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""One-shot real-TPU validation pass (run when the tunnel is live).
+
+Runs, in order, each in its own subprocess so one hang can't kill the
+rest:
+  1. device probe (platform + kind)
+  2. bench.py              -> headline img/s + MFU JSON line
+  3. TPU-marked pytest     -> flash-attention Mosaic compile fwd+bwd
+  4. caffe time alexnet    -> per-layer + fused timings + MFU
+  5. short `caffe train -gpu all` on synthetic lenet shapes
+
+Usage: python tools/tpu_validation.py [--quick]
+Writes a summary to tpu_validation.log (repo root).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run(name, cmd, timeout, log):
+    print(f"=== {name}: {' '.join(cmd)}", flush=True)
+    t0 = time.time()
+    try:
+        r = subprocess.run(cmd, cwd=_ROOT, timeout=timeout,
+                           capture_output=True, text=True)
+        ok = r.returncode == 0
+        tail = (r.stdout + r.stderr).strip().splitlines()[-12:]
+    except subprocess.TimeoutExpired:
+        ok, tail = False, [f"TIMEOUT after {timeout}s"]
+    dt = time.time() - t0
+    status = "OK" if ok else "FAIL"
+    log.write(f"[{status}] {name} ({dt:.0f}s)\n")
+    for line in tail:
+        log.write(f"    {line}\n")
+    log.flush()
+    print("\n".join(tail[-6:]))
+    print(f"=== {name}: {status} ({dt:.0f}s)\n", flush=True)
+    return ok
+
+
+def main() -> int:
+    quick = "--quick" in sys.argv
+    py = sys.executable
+    with open(os.path.join(_ROOT, "tpu_validation.log"), "w") as log:
+        log.write(f"TPU validation @ {time.ctime()}\n")
+        probe_ok = run(
+            "probe",
+            [py, "-c",
+             "import jax, jax.numpy as jnp; d = jax.devices()[0]; "
+             "print(d.platform, d.device_kind, len(jax.devices())); "
+             "print('sum:', float(jnp.sum(jnp.ones(64))))"],
+            120, log)
+        if not probe_ok:
+            log.write("tunnel down; aborting\n")
+            print("tunnel down; aborting")
+            return 1
+        run("bench", [py, "bench.py"], 600, log)
+        # NOT via pytest: tests/conftest.py pins the CPU platform; the
+        # whole point here is the real Mosaic lowering
+        run("flash-mosaic",
+            [py, "-c", """
+import numpy as np, jax, jax.numpy as jnp
+from caffe_mpi_tpu.ops.attention import attention
+from caffe_mpi_tpu.ops.flash_attention import flash_attention
+assert jax.devices()[0].platform == 'tpu'
+r = np.random.RandomState(0)
+mk = lambda: jnp.asarray(r.randn(2, 256, 2, 32).astype(np.float32))
+q, k, v = mk(), mk(), mk()
+for causal in (False, True):
+    ref = attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, interpret=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=1e-4)
+    g = jax.grad(lambda q: jnp.sum(flash_attention(
+        q, k, v, causal=causal, interpret=False) ** 2))(q)
+    gr = jax.grad(lambda q: jnp.sum(
+        attention(q, k, v, causal=causal) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
+                               rtol=5e-3, atol=1e-3)
+    print(f'causal={causal}: fwd+bwd Mosaic kernels match reference')
+"""],
+            900, log)
+        if not quick:
+            run("caffe-time-alexnet",
+                [py, "-m", "caffe_mpi_tpu.tools.cli", "time",
+                 "-model", "models/alexnet/train_val.prototxt",
+                 "-phase", "TRAIN", "-iterations", "10"],
+                600, log)
+            run("train-gpu-all",
+                [py, "-m", "caffe_mpi_tpu.tools.cli", "train",
+                 "-solver", "models/lenet/lenet_solver.prototxt",
+                 "-synthetic", "-max_iter", "200", "-gpu", "all"],
+                600, log)
+    print("summary written to tpu_validation.log")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

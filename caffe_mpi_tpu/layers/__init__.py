@@ -7,5 +7,6 @@ from . import data_layers  # noqa: F401
 from . import dense  # noqa: F401
 from . import losses  # noqa: F401
 from . import norm  # noqa: F401
+from . import sequence  # noqa: F401
 from . import shape_ops  # noqa: F401
 from . import vision  # noqa: F401

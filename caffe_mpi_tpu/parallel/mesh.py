@@ -135,10 +135,13 @@ class MeshPlan:
     def param_sharding_rules(self, rules: dict[str, tuple]):
         """Declare per-layer weight shardings over the 'model' axis.
 
-        rules: {layer_name: partition_spec_tuple | "rows"}, e.g.
+        rules: {layer_name: partition_spec_tuple | "rows" | per-param dict}:
           {"fc6": ("model", None)} (or the "rows" shorthand) shards fc6's
-          weight dim 0 (output features) over 'model'. Returns a placement
-          function for param pytrees.
+          weight dim 0 (output features) over 'model';
+          {"moe1": {"w1": ("model",), "w2": ("model",), "b1": ("model",),
+                    "b2": ("model",)}} gives expert parallelism — each
+          listed param gets its own spec, unlisted params replicate.
+        Returns a placement function for param pytrees.
 
         With params sharded and activations batch-sharded, XLA's GSPMD
         partitioner inserts the all-gather/reduce-scatter pattern of
@@ -151,7 +154,24 @@ class MeshPlan:
                 rule = rules.get(lname)
                 placed = {}
                 for pname, arr in lparams.items():
-                    if rule is not None and pname == "weight":
+                    if isinstance(rule, dict):
+                        spec = rule.get(pname)
+                        if spec is None:
+                            placed[pname] = jax.device_put(
+                                arr, self.replicated())
+                        else:
+                            if spec == "rows":
+                                spec = ("model",)
+                            elif isinstance(spec, str):
+                                raise ValueError(
+                                    f"per-param rule for {lname}/{pname} "
+                                    f"must be a spec tuple or 'rows', got "
+                                    f"{spec!r}")
+                            spec = list(spec)[:arr.ndim]
+                            spec += [None] * (arr.ndim - len(spec))
+                            placed[pname] = jax.device_put(
+                                arr, NamedSharding(self.mesh, P(*spec)))
+                    elif rule is not None and pname == "weight":
                         if rule == "rows":
                             spec = ["model"] + [None] * (arr.ndim - 1)
                         else:

@@ -411,6 +411,33 @@ class AccuracyParameter(Message):
 
 
 @dataclass
+class AttentionParameter(Message):
+    """TPU-native extension (no reference analogue — SURVEY §5.7: the
+    reference has no attention op at all): multi-head self-attention over
+    (N, S, C) blobs, with optional Pallas flash kernels and ring-attention
+    sequence parallelism."""
+    num_heads: int = 1
+    causal: bool = False
+    use_flash: bool = False
+    bias_term: bool = True
+    weight_filler: FillerParameter | None = None
+    bias_filler: FillerParameter | None = None
+
+
+@dataclass
+class MoEParameter(Message):
+    """TPU-native extension (no reference analogue — SURVEY §2.7: EP
+    absent): mixture-of-experts FFN with top-k routing and capacity,
+    experts shardable over a mesh axis (ops/moe.py). A second top, when
+    named, carries the load-balancing auxiliary loss."""
+    num_experts: int = 0
+    hidden_dim: int = 0
+    top_k: int = 1
+    capacity_factor: float = 2.0
+    weight_filler: FillerParameter | None = None
+
+
+@dataclass
 class HingeLossParameter(Message):
     norm: str = "L1"  # L1 / L2
 
@@ -698,6 +725,7 @@ class LayerParameter(Message):
     loss_param: LossParameter | None = None
 
     accuracy_param: AccuracyParameter | None = None
+    attention_param: AttentionParameter | None = None
     argmax_param: ArgMaxParameter | None = None
     batch_norm_param: BatchNormParameter | None = None
     bias_param: BiasParameter | None = None
@@ -711,6 +739,7 @@ class LayerParameter(Message):
     dropout_param: DropoutParameter | None = None
     dummy_data_param: DummyDataParameter | None = None
     eltwise_param: EltwiseParameter | None = None
+    moe_param: MoEParameter | None = None
     elu_param: ELUParameter | None = None
     embed_param: EmbedParameter | None = None
     exp_param: ExpParameter | None = None

@@ -1,0 +1,176 @@
+"""Attention + MoE as prototxt layer types (layers/sequence.py) — the
+TPU-native extension surface: gradchecked like every other op, trainable
+through the Solver, and expert-shardable via param_shardings."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from caffe_mpi_tpu.net import Net
+from caffe_mpi_tpu.proto import NetParameter, SolverParameter
+from caffe_mpi_tpu.solver import Solver
+
+from gradcheck import check_gradients
+from test_layers import make_layer, rand
+
+
+class TestAttentionLayer:
+    def _layer(self, extra="", shape=(2, 8, 16)):
+        return make_layer(
+            'name: "attn" type: "Attention" bottom: "x" top: "y"\n'
+            f'attention_param {{ num_heads: 4 {extra} }}',
+            [shape],
+        )
+
+    def test_output_shape_and_params(self, rng):
+        layer, params, state = self._layer()
+        assert set(params) == {"qkv_weight", "qkv_bias", "proj_weight",
+                               "proj_bias"}
+        assert params["qkv_weight"].shape == (48, 16)
+        x = rand((2, 8, 16), rng)
+        (y,), _ = layer.apply(params, state, [x], train=True, rng=None)
+        assert y.shape == (2, 8, 16)
+
+    def test_matches_ops_attention(self, rng):
+        """The layer is exactly qkv-proj + ops.attention + out-proj."""
+        from caffe_mpi_tpu.ops.attention import attention
+        layer, params, state = self._layer("causal: true")
+        x = rand((2, 8, 16), rng)
+        (y,), _ = layer.apply(params, state, [x], train=True, rng=None)
+        qkv = np.asarray(x) @ np.asarray(params["qkv_weight"]).T \
+            + np.asarray(params["qkv_bias"])
+        q, k, v = np.split(qkv, 3, axis=-1)
+        shp = (2, 8, 4, 4)
+        ref = attention(jnp.asarray(q.reshape(shp)),
+                        jnp.asarray(k.reshape(shp)),
+                        jnp.asarray(v.reshape(shp)), causal=True)
+        ref = np.asarray(ref).reshape(2, 8, 16) \
+            @ np.asarray(params["proj_weight"]).T \
+            + np.asarray(params["proj_bias"])
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-5)
+
+    def test_gradients(self, rng):
+        layer, params, state = self._layer(shape=(1, 4, 8))
+        check_gradients(layer, params, state, [rand((1, 4, 8), rng)])
+
+    def test_causal_gradients(self, rng):
+        layer, params, state = self._layer("causal: true", shape=(1, 4, 8))
+        check_gradients(layer, params, state, [rand((1, 4, 8), rng)])
+
+    def test_rejects_bad_heads(self):
+        with pytest.raises(ValueError, match="divisible"):
+            self._layer("num_heads: 5")
+
+    def test_param_block_optional(self, rng):
+        """No attention_param block -> single-head defaults, not a crash."""
+        layer, params, state = make_layer(
+            'name: "a" type: "Attention" bottom: "x" top: "y"', [(1, 4, 8)])
+        (y,), _ = layer.apply(params, state, [rand((1, 4, 8), rng)],
+                              train=True, rng=None)
+        assert y.shape == (1, 4, 8)
+
+    def test_moe_param_required(self):
+        with pytest.raises(ValueError, match="num_experts"):
+            make_layer('name: "m" type: "MoE" bottom: "x" top: "y"',
+                       [(4, 8)])
+
+
+class TestMoELayer:
+    TEXT = ('name: "moe" type: "MoE" bottom: "x" top: "y" top: "aux"\n'
+            'loss_weight: 0 loss_weight: 0.01\n'
+            'moe_param { num_experts: 4 hidden_dim: 32 top_k: 1 '
+            'capacity_factor: 8.0 }')
+
+    def test_matches_ops_moe(self, rng):
+        from caffe_mpi_tpu.ops.moe import moe_ffn_dense_reference
+        layer, params, state = make_layer(self.TEXT, [(16, 8)])
+        x = rand((16, 8), rng)
+        (y, aux), _ = layer.apply(params, state, [x], train=True, rng=None)
+        ref = moe_ffn_dense_reference(
+            {k: jnp.asarray(v) for k, v in params.items()}, x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+        assert np.isfinite(float(aux))
+
+    def test_sequence_input(self, rng):
+        layer, params, state = make_layer(self.TEXT, [(2, 6, 8)])
+        x = rand((2, 6, 8), rng)
+        (y, aux), _ = layer.apply(params, state, [x], train=True, rng=None)
+        assert y.shape == (2, 6, 8)
+
+    def test_trains_with_aux_loss_in_net(self, rng):
+        """Full prototxt surface: MoE inside a Net, aux top weighted into
+        the loss, trains through the Solver."""
+        net_text = """
+        name: "moenet"
+        layer { name: "in" type: "Input" top: "x" top: "t"
+                input_param { shape { dim: 16 dim: 8 } shape { dim: 16 } } }
+        layer { name: "moe1" type: "MoE" bottom: "x" top: "h" top: "moe_aux"
+                loss_weight: 0 loss_weight: 0.01
+                moe_param { num_experts: 4 hidden_dim: 32
+                            capacity_factor: 8.0 } }
+        layer { name: "ip" type: "InnerProduct" bottom: "h" top: "y"
+                inner_product_param { num_output: 4
+                  weight_filler { type: "xavier" } } }
+        layer { name: "loss" type: "SoftmaxWithLoss" bottom: "y" bottom: "t"
+                top: "l" }
+        """
+        sp = SolverParameter.from_text(
+            'base_lr: 0.1 momentum: 0.9 lr_policy: "fixed" max_iter: 100 '
+            'display: 0 type: "SGD"')
+        sp.net_param = NetParameter.from_text(net_text)
+        solver = Solver(sp)
+        templates = rng.randn(4, 8).astype(np.float32)
+
+        def feed(it):
+            r = np.random.RandomState(it % 8)
+            t = r.randint(0, 4, 16)
+            return {"x": jnp.asarray(templates[t]
+                                     + 0.2 * r.randn(16, 8).astype(np.float32)),
+                    "t": jnp.asarray(t)}
+
+        first = float(solver.step(1, feed))
+        last = float(solver.step(80, feed))
+        assert last < first * 0.5, (first, last)
+
+    def test_expert_parallel_via_solver_shardings(self, rng):
+        """EP from the training surface: per-param dict rules shard the
+        expert banks over 'model'; training matches the replicated run."""
+        from caffe_mpi_tpu.parallel import MeshPlan
+        net_text = """
+        layer { name: "in" type: "Input" top: "x" top: "t"
+                input_param { shape { dim: 16 dim: 8 } shape { dim: 16 } } }
+        layer { name: "moe1" type: "MoE" bottom: "x" top: "h"
+                moe_param { num_experts: 4 hidden_dim: 16
+                            capacity_factor: 8.0 } }
+        layer { name: "ip" type: "InnerProduct" bottom: "h" top: "y"
+                inner_product_param { num_output: 4
+                  weight_filler { type: "xavier" } } }
+        layer { name: "loss" type: "SoftmaxWithLoss" bottom: "y" bottom: "t"
+                top: "l" }
+        """
+        data = []
+        r = np.random.RandomState(3)
+        for _ in range(4):
+            data.append({"x": jnp.asarray(r.randn(16, 8).astype(np.float32)),
+                         "t": jnp.asarray(r.randint(0, 4, 16))})
+
+        def ms(shardings):
+            sp = SolverParameter.from_text(
+                'base_lr: 0.05 momentum: 0.9 lr_policy: "fixed" '
+                'max_iter: 8 type: "SGD" random_seed: 7')
+            sp.net_param = NetParameter.from_text(net_text)
+            return Solver(sp, mesh=MeshPlan.from_shape(data=2, model=4),
+                          param_shardings=shardings)
+
+        ep = {"moe1": {"w1": ("model",), "b1": ("model",),
+                       "w2": ("model",), "b2": ("model",)}}
+        s_ep = ms(ep)
+        s_rep = ms(None)
+        assert not s_ep.params["moe1"]["w1"].sharding.is_fully_replicated
+        s_ep.step(6, lambda it: data[it % 4])
+        s_rep.step(6, lambda it: data[it % 4])
+        np.testing.assert_allclose(np.array(s_ep.params["moe1"]["w1"]),
+                                   np.array(s_rep.params["moe1"]["w1"]),
+                                   rtol=2e-4, atol=1e-6)

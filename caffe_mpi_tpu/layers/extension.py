@@ -84,8 +84,12 @@ class PythonLayer(Layer):
             fwd.defvjp(fwd_fwd, fwd_bwd)
             tops = fwd(*bottoms)
         else:
-            tops = jax.pure_callback(host_forward, out_structs, *bottoms)
-            tops = [jax.lax.stop_gradient(t) for t in tops]
+            # non-differentiable: gradients must stop at the INPUTS —
+            # stopping only the outputs still lets linearization reach the
+            # callback, which has no JVP rule and raises
+            tops = jax.pure_callback(
+                host_forward, out_structs,
+                *[jax.lax.stop_gradient(b) for b in bottoms])
         tops = [t.astype(self.policy.forward) for t in tops]
         return list(tops), state
 

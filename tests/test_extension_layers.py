@@ -24,6 +24,20 @@ class DoubleLayer:
         return [2.0 * bottoms[0]]
 
 
+class SquareLayer:
+    """User layer WITH backward: y = x^2, dx = 2x * dy (the reference's
+    python_layer Backward protocol, numpy on host)."""
+
+    def infer_shapes(self, bottom_shapes):
+        return [bottom_shapes[0]]
+
+    def forward(self, bottoms):
+        return [bottoms[0] ** 2]
+
+    def backward(self, top_diffs, bottoms):
+        return [2.0 * bottoms[0] * top_diffs[0]]
+
+
 class TestPythonLayer:
     def test_forward_through_callback(self, rng):
         net = Net(NetParameter.from_text("""
@@ -40,6 +54,41 @@ class TestPythonLayer:
         blobs = fwd(params, state, {"x": x})
         np.testing.assert_allclose(np.array(blobs["y"]), 2 * np.array(x),
                                    rtol=1e-6)
+
+
+class TestPythonLayerBackward:
+    NET = """
+    layer { name: "in" type: "Input" top: "x"
+            input_param { shape { dim: 2 dim: 3 } } }
+    layer { name: "py" type: "Python" bottom: "x" top: "y"
+            python_param { module: "test_extension_layers"
+                           layer: "SquareLayer" } }
+    """
+
+    def test_user_backward_is_custom_vjp(self, rng):
+        """jax.grad through the Python layer calls the user's numpy
+        backward (spliced in as a custom VJP through pure_callback)."""
+        net = Net(NetParameter.from_text(self.NET))
+        params, state = net.init(jax.random.PRNGKey(0))
+        x = jnp.asarray(rng.randn(2, 3).astype(np.float32))
+
+        def loss(x):
+            blobs, _, _ = net.apply(params, state, {"x": x}, train=True)
+            return jnp.sum(blobs["y"] * jnp.arange(1.0, 7.0).reshape(2, 3))
+
+        g = jax.grad(loss)(x)
+        # d/dx sum(w * x^2) = 2 w x
+        expect = 2 * np.arange(1.0, 7.0).reshape(2, 3) * np.array(x)
+        np.testing.assert_allclose(np.array(g), expect, rtol=1e-5)
+
+    def test_no_backward_stops_gradient(self, rng):
+        net = Net(NetParameter.from_text(self.NET.replace(
+            "SquareLayer", "DoubleLayer")))
+        params, state = net.init(jax.random.PRNGKey(0))
+        x = jnp.asarray(rng.randn(2, 3).astype(np.float32))
+        g = jax.grad(lambda x: jnp.sum(
+            net.apply(params, state, {"x": x}, train=True)[0]["y"]))(x)
+        np.testing.assert_array_equal(np.array(g), 0.0)
 
 
 class TestFilter:

@@ -1058,7 +1058,9 @@ def main():
     # fp16 variants (reference models/resnet50/train_val_fp16.prototxt +
     # solver_fp16.prototxt): FLOAT16 -> bfloat16 on TPU, f32 master
     # weights, loss scaling
-    for name in ("resnet50", "alexnet"):
+    # the reference ships fp16 variants for these families
+    for name in ("resnet50", "resnet18", "alexnet", "alexnet_owt",
+                 "googlenet", "inception_v2", "inception_v3", "vgg16"):
         d = os.path.join(out_root, name)
         base = open(os.path.join(d, "train_val.prototxt")).read()
         with open(os.path.join(d, "train_val_fp16.prototxt"), "w") as f:

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import signal
 import sys
 import time
@@ -378,6 +379,20 @@ def main(argv=None) -> int:
         format="%(levelname).1s%(asctime)s %(name)s] %(message)s",
         datefmt="%m%d %H:%M:%S")
     args = _parser().parse_args(argv)
+    # persistent XLA compilation cache: repeat invocations of the same
+    # model skip the 20-40s TPU compile (JAX_COMPILATION_CACHE_DIR
+    # overrides; set it empty to disable)
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                               os.path.join(os.path.expanduser("~"),
+                                            ".cache", "caffe_mpi_tpu_xla"))
+    if cache_dir:
+        try:
+            import jax
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              1.0)
+        except Exception:
+            pass
     return {
         "train": cmd_train,
         "test": cmd_test,

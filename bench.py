@@ -77,26 +77,15 @@ def probe():
     return None
 
 
-def _enable_compile_cache():
-    """Persistent XLA compilation cache: the AlexNet step costs ~20-40s to
-    compile on TPU — a warm cache turns the retry attempt (and every later
-    bench run) into a disk hit. Harmless when the dir is cold."""
-    import jax
-    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                               os.path.join(_ROOT, ".jax_cache"))
-    try:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass  # older jax: cache flag absent
-
-
 def run_bench():
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    _enable_compile_cache()
+    # warm-cacheable compiles: the retry child + later runs skip the
+    # ~20-40s AlexNet-step compile
+    from caffe_mpi_tpu.utils.compile_cache import enable_compile_cache
+    enable_compile_cache(os.path.join(_ROOT, ".jax_cache"))
 
     from caffe_mpi_tpu.proto import NetParameter, SolverParameter
     from caffe_mpi_tpu.solver import Solver

@@ -160,14 +160,10 @@ class ParameterLayer(Layer):
 
     def setup(self, in_shapes: list[Shape]) -> list[Shape]:
         from ..proto.config import FillerParameter
-        node = getattr(self.lp, "_node", None)
-        shape = None
-        if node is not None and "parameter_param" in node:
-            pp = node.get("parameter_param")
-            if "shape" in pp:
-                shape = tuple(pp.get("shape").get_list("dim"))
-        if shape is None:
+        pp = self.lp.parameter_param
+        if pp is None or pp.shape is None or not pp.shape.dim:
             raise ValueError(f"{self.name}: parameter_param.shape required")
+        shape = tuple(int(d) for d in pp.shape.dim)
         self.declare("weight", shape, FillerParameter(type="constant"))
         return [shape]
 

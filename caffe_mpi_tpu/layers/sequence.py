@@ -31,6 +31,36 @@ from ..proto.config import FillerParameter
 from .base import Layer, Shape, register
 
 
+@register("LayerNorm")
+class LayerNormLayer(Layer):
+    """Per-position normalization over the trailing (channel) axis — the
+    transformer companion to BatchNorm the reference never needed
+    (layer_norm_param { eps scale_bias }). Stateless (no running stats),
+    so it is the same pure function in TRAIN and TEST."""
+
+    def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        from ..proto.config import LayerNormParameter
+        p = self.lp.layer_norm_param or LayerNormParameter()
+        self.p = p
+        c = in_shapes[0][-1]
+        if p.scale_bias:
+            self.declare("scale", (c,),
+                         FillerParameter(type="constant", value=1.0))
+            self.declare("bias", (c,), FillerParameter(type="constant"))
+        return [in_shapes[0]]
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        x = self.f(bottoms[0])
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.mean((x32 - mean) ** 2, axis=-1, keepdims=True)
+        y = (x32 - mean) * jax.lax.rsqrt(var + self.p.eps)
+        y = y.astype(x.dtype)
+        if self.p.scale_bias:
+            y = y * self.f(params["scale"]) + self.f(params["bias"])
+        return [y], state
+
+
 @register("Attention")
 class AttentionLayer(Layer):
     def setup(self, in_shapes: list[Shape]) -> list[Shape]:

@@ -21,7 +21,8 @@ _PARAM_FIELD = {
     "Concat": "concat_param", "ContrastiveLoss": "contrastive_loss_param",
     "Convolution": "convolution_param", "Deconvolution": "convolution_param",
     "Crop": "crop_param", "Data": "data_param", "Dropout": "dropout_param",
-    "Attention": "attention_param", "MoE": "moe_param",
+    "Attention": "attention_param", "LayerNorm": "layer_norm_param",
+    "MoE": "moe_param", "Parameter": "parameter_param",
     "DummyData": "dummy_data_param", "Eltwise": "eltwise_param",
     "ELU": "elu_param", "Embed": "embed_param", "Exp": "exp_param",
     "Flatten": "flatten_param", "HDF5Data": "hdf5_data_param",

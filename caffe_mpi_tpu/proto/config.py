@@ -425,6 +425,21 @@ class AttentionParameter(Message):
 
 
 @dataclass
+class ParameterParameter(Message):
+    """parameter_layer.hpp: expose a learnable blob of the given shape."""
+    shape: BlobShape | None = None
+
+
+@dataclass
+class LayerNormParameter(Message):
+    """TPU-native extension (the reference has BatchNorm/MVN but no
+    per-position LayerNorm — it predates transformers): normalize over the
+    trailing axis with learnable scale/bias."""
+    eps: float = 1e-5
+    scale_bias: bool = True
+
+
+@dataclass
 class MoEParameter(Message):
     """TPU-native extension (no reference analogue — SURVEY §2.7: EP
     absent): mixture-of-experts FFN with top-k routing and capacity,
@@ -740,6 +755,8 @@ class LayerParameter(Message):
     dummy_data_param: DummyDataParameter | None = None
     eltwise_param: EltwiseParameter | None = None
     moe_param: MoEParameter | None = None
+    layer_norm_param: LayerNormParameter | None = None
+    parameter_param: ParameterParameter | None = None
     elu_param: ELUParameter | None = None
     embed_param: EmbedParameter | None = None
     exp_param: ExpParameter | None = None

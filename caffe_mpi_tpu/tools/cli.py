@@ -64,14 +64,27 @@ def _select_mesh(gpu_flag: str):
 
 def _synthetic_feed(net, seed=0):
     """Random feeds shaped from the net's Input layers (the reference's
-    `caffe time` uses dummy data the same way)."""
+    `caffe time` uses dummy data the same way). Integer feeds are chosen
+    by CONSUMER, not by blob name: a blob eaten by Embed gets token ids in
+    [0, input_dim); the target bottom of a classification loss/accuracy
+    gets class ids."""
     import jax.numpy as jnp
     r = np.random.RandomState(seed)
+    int_range: dict[str, int] = {}
+    for layer in net.layers:
+        lp = layer.lp
+        if lp.type == "Embed" and lp.bottom:
+            int_range[lp.bottom[0]] = lp.embed_param.input_dim
+        elif lp.type in ("SoftmaxWithLoss", "Accuracy",
+                         "InfogainLoss", "MultinomialLogisticLoss") \
+                and len(lp.bottom) > 1:
+            int_range.setdefault(lp.bottom[1], 10)
     feeds = {}
     for blob in net.feed_blobs:
         shape = net.blob_shapes[blob]
-        if blob == "label":
-            feeds[blob] = jnp.asarray(r.randint(0, 10, shape))
+        if blob in int_range:
+            feeds[blob] = jnp.asarray(
+                r.randint(0, max(int_range[blob], 1), shape))
         else:
             feeds[blob] = jnp.asarray(r.randn(*shape).astype(np.float32))
     return feeds
@@ -379,20 +392,8 @@ def main(argv=None) -> int:
         format="%(levelname).1s%(asctime)s %(name)s] %(message)s",
         datefmt="%m%d %H:%M:%S")
     args = _parser().parse_args(argv)
-    # persistent XLA compilation cache: repeat invocations of the same
-    # model skip the 20-40s TPU compile (JAX_COMPILATION_CACHE_DIR
-    # overrides; set it empty to disable)
-    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                               os.path.join(os.path.expanduser("~"),
-                                            ".cache", "caffe_mpi_tpu_xla"))
-    if cache_dir:
-        try:
-            import jax
-            jax.config.update("jax_compilation_cache_dir", cache_dir)
-            jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                              1.0)
-        except Exception:
-            pass
+    from ..utils.compile_cache import enable_compile_cache
+    enable_compile_cache()
     return {
         "train": cmd_train,
         "test": cmd_test,

@@ -791,7 +791,85 @@ def rcnn(batch=10):
     return spec
 
 
+def transformer_lm(batch=8, seq=64, vocab=256, dim=128, heads=4,
+                   n_blocks=2, ffn_hidden=256, moe_experts=4):
+    """Decoder-only language model — the beyond-reference flagship for the
+    long-context stack, expressed entirely in prototxt layer types:
+    Embed + learnable positional bias, pre-LN blocks of causal Attention
+    and FFN (one block's FFN is an MoE with a weighted aux-loss top),
+    trailing LayerNorm, per-position classifier, spatial SoftmaxWithLoss.
+    The reference (a CNN framework) has no analogue; every extension type
+    used here (Attention/MoE/LayerNorm) is registered and gradchecked
+    like the reference ops."""
+    n = NetSpec("transformer_lm")
+    n.tokens, n.label = L.Input(ntop=2, input_param=dict(
+        shape=[dict(dim=[batch, seq]), dict(dim=[batch, seq])]))
+    n.embed = L.Embed(n.tokens, input_dim=vocab, num_output=dim,
+                      bias_term=False,
+                      weight_filler=dict(type="gaussian", std=0.02))
+    n.pos = L.Parameter(ntop=1, parameter_param=dict(
+        shape=dict(dim=[seq, dim])))
+    # broadcast-add positions onto (N, S, C) starting at axis 1
+    n.x0 = L.Bias(n.embed, n.pos, axis=1)
+    x = n.x0
+    for b in range(n_blocks):
+        ln1 = L.LayerNorm(x)
+        setattr(n, f"blk{b}/ln1", ln1)
+        attn = L.Attention(ln1, num_heads=heads, causal=True,
+                           weight_filler=dict(type="gaussian", std=0.02))
+        setattr(n, f"blk{b}/attn", attn)
+        res1 = L.Eltwise(x, attn)
+        setattr(n, f"blk{b}/res1", res1)
+        ln2 = L.LayerNorm(res1)
+        setattr(n, f"blk{b}/ln2", ln2)
+        if b == n_blocks - 1 and moe_experts:
+            moe_y, moe_aux = L.MoE(ln2, ntop=2,
+                                   loss_weight=[0.0, 0.01],
+                                   moe_param=dict(num_experts=moe_experts,
+                                                  hidden_dim=ffn_hidden,
+                                                  capacity_factor=2.0))
+            setattr(n, f"blk{b}/moe", moe_y)
+            setattr(n, f"blk{b}/moe_aux", moe_aux)
+            ffn = moe_y
+        else:
+            fc1 = L.InnerProduct(ln2, num_output=ffn_hidden, axis=2,
+                                 weight_filler=dict(type="gaussian",
+                                                    std=0.02))
+            setattr(n, f"blk{b}/fc1", fc1)
+            setattr(n, f"blk{b}/relu", L.ReLU(fc1, in_place=True))
+            ffn = L.InnerProduct(fc1, num_output=dim, axis=2,
+                                 weight_filler=dict(type="gaussian",
+                                                    std=0.02))
+            setattr(n, f"blk{b}/fc2", ffn)
+        res2 = L.Eltwise(res1, ffn)
+        setattr(n, f"blk{b}/res2", res2)
+        x = res2
+    n.ln_f = L.LayerNorm(x)
+    n.logits = L.InnerProduct(n.ln_f, num_output=vocab, axis=2,
+                              weight_filler=dict(type="gaussian", std=0.02))
+    n.loss = L.SoftmaxWithLoss(n.logits, n.label,
+                               softmax_param=dict(axis=2))
+    n.accuracy = L.Accuracy(n.logits, n.label, axis=2,
+                            include=dict(phase="TEST"))
+    return n
+
+
 SOLVERS = {
+    "transformer_lm": """# transformer_lm solver (beyond-reference demo model; Adam recipe)
+net: "models/transformer_lm/train_val.prototxt"
+test_iter: 16
+test_interval: 1000
+test_initialization: false
+base_lr: 0.001
+lr_policy: "fixed"
+display: 100
+max_iter: 10000
+momentum: 0.9
+momentum2: 0.999
+type: "Adam"
+snapshot: 10000
+snapshot_prefix: "models/transformer_lm/transformer_lm"
+""",
     "alexnet": """# AlexNet solver (reference models/bvlc_alexnet/solver.prototxt recipe)
 net: "models/alexnet/train_val.prototxt"
 test_iter: 1000
@@ -1012,9 +1090,11 @@ def make_deploy(train_val_path: str, batch: int = 10) -> str:
     for lp in kept:
         node = lp.to_node()
         if lp.type == "Input":
-            # single data input at deploy batch size
+            # single data input at deploy batch size (keep the net's own
+            # first top name — image nets call it "data", the LM "tokens")
+            first_top = lp.top[0]
             node.fields.pop("top", None)
-            node.add("top", "data")
+            node.add("top", first_top)
             ip = PbNode()
             shape = PbNode()
             dims = lp.input_param.shape[0].dim
@@ -1047,6 +1127,7 @@ def main():
         "resnet50": resnet50(),
         "vgg16": vgg16(),
         "cifar10_nv": cifar10_nv(),
+        "transformer_lm": transformer_lm(),
     }
     # deploy-only model (no solver): rcnn
     d = os.path.join(out_root, "rcnn")

@@ -76,6 +76,81 @@ class TestAttentionLayer:
                        [(4, 8)])
 
 
+class TestLayerNorm:
+    def test_matches_manual(self, rng):
+        layer, params, state = make_layer(
+            'name: "ln" type: "LayerNorm" bottom: "x" top: "y"', [(2, 5, 8)])
+        x = rand((2, 5, 8), rng)
+        (y,), _ = layer.apply(params, state, [x], train=True, rng=None)
+        xn = np.asarray(x)
+        mean = xn.mean(-1, keepdims=True)
+        var = xn.var(-1, keepdims=True)
+        ref = (xn - mean) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-5)
+
+    def test_gradients(self, rng):
+        layer, params, state = make_layer(
+            'name: "ln" type: "LayerNorm" bottom: "x" top: "y"\n'
+            'layer_norm_param { eps: 0.001 }', [(2, 3, 6)])
+        check_gradients(layer, params, state, [rand((2, 3, 6), rng)])
+
+    def test_no_scale_bias(self, rng):
+        layer, params, state = make_layer(
+            'name: "ln" type: "LayerNorm" bottom: "x" top: "y"\n'
+            'layer_norm_param { scale_bias: false }', [(2, 8)])
+        assert params == {}
+
+
+class TestTransformerLM:
+    def test_zoo_model_builds(self):
+        """The generated models/transformer_lm prototxts build (train_val
+        and deploy) — the long-context stack from the declarative surface."""
+        from caffe_mpi_tpu.net import Net
+        net = Net(NetParameter.from_file(
+            "models/transformer_lm/train_val.prototxt"), phase="TRAIN")
+        assert net.blob_shapes["logits"] == (8, 64, 256)
+        types = {l.lp.type for l in net.layers}
+        assert {"Embed", "Attention", "MoE", "LayerNorm",
+                "Eltwise"} <= types
+        Net(NetParameter.from_file(
+            "models/transformer_lm/deploy.prototxt"), phase="TEST")
+
+    def test_induction_task_convergence(self, rng):
+        """A tiny LM learns 'x[t+1] = x[t-3]' (period-4 copy) to >=90%
+        held-out next-token accuracy — a task that REQUIRES attending
+        backwards, so it proves the causal-attention training path, not
+        just the FFN."""
+        import sys
+        sys.path.insert(0, "models")
+        from generate_models import transformer_lm
+        text = transformer_lm(batch=8, seq=32, vocab=32, dim=32, heads=2,
+                              n_blocks=1, ffn_hidden=64,
+                              moe_experts=4).to_prototxt()
+        sp = SolverParameter.from_text(
+            'base_lr: 0.003 momentum: 0.9 momentum2: 0.999 type: "Adam" '
+            'lr_policy: "fixed" max_iter: 400 display: 0')
+        sp.net_param = NetParameter.from_text(text)
+        solver = Solver(sp)
+
+        B, S, V = 8, 32, 32
+
+        def feed(it):
+            r = np.random.RandomState(it)
+            base = r.randint(0, V, (B, 4))
+            seq = np.tile(base, (1, S // 4 + 2))[:, :S + 1]
+            return {"tokens": jnp.asarray(seq[:, :S]),
+                    "label": jnp.asarray(seq[:, 1:S + 1])}
+
+        solver.step(300, feed)
+        f = feed(10_001)
+        blobs, _, _ = solver.net.apply(solver.params, solver.net_state, f,
+                                       train=False)
+        pred = np.asarray(jnp.argmax(blobs["logits"], axis=-1))
+        lab = np.asarray(f["label"])
+        acc = (pred[:, 8:] == lab[:, 8:]).mean()
+        assert acc >= 0.9, acc
+
+
 class TestMoELayer:
     TEXT = ('name: "moe" type: "MoE" bottom: "x" top: "y" top: "aux"\n'
             'loss_weight: 0 loss_weight: 0.01\n'

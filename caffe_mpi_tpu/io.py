@@ -255,10 +255,26 @@ def load_caffemodel_h5(path: str) -> dict[str, list[np.ndarray]]:
     out: dict[str, list[np.ndarray]] = {}
     with h5py.File(path, "r") as f:
         data = f["data"]
-        for lname in data:
-            g = data[lname]
-            out[lname] = [np.asarray(g[str(i)])
-                          for i in range(len(g.keys()))]
+
+        # layer names may contain '/' (GoogLeNet's inception_3a/1x1),
+        # which HDF5 stores as NESTED groups — walk to the leaf groups
+        # whose children are the positional blob datasets and rebuild the
+        # layer name from the path (the reference reads by name, which
+        # resolves nesting implicitly; iterating must recurse)
+        def walk(group, prefix):
+            keys = list(group.keys())
+            if keys and all(isinstance(group[k], h5py.Dataset)
+                            for k in keys):
+                out[prefix] = [np.asarray(group[str(i)])
+                               for i in range(len(keys))]
+                return
+            for k in keys:
+                child = group[k]
+                name = f"{prefix}/{k}" if prefix else k
+                if isinstance(child, h5py.Group):
+                    walk(child, name)
+
+        walk(data, "")
     return out
 
 

@@ -103,6 +103,24 @@ class TestWeightRoundTrip:
         np.testing.assert_array_equal(np.array(params2["conv1"]["weight"]), w0)
         np.testing.assert_array_equal(np.array(params2["ip"]["weight"]), 1.0)
 
+    def test_h5_roundtrip_with_slash_layer_names(self, tmp_path):
+        """GoogLeNet-style names (inception_3a/1x1) nest as HDF5 groups;
+        the loader must walk to the leaf groups and rebuild the names
+        (the reference resolves them by name, net.cpp ToHDF5/CopyFrom)."""
+        w = {"inception_3a/1x1": [np.ones((4, 2), np.float32),
+                                  np.arange(4, dtype=np.float32)],
+             "inception_3a/pool_proj": [np.full((2, 2), 3.0, np.float32)],
+             "conv1/7x7_s2": [np.zeros((2, 3), np.float32)],
+             "plain": [np.ones(3, np.float32)]}
+        p = str(tmp_path / "w.caffemodel.h5")
+        save_caffemodel_h5(p, w)
+        back = load_caffemodel_h5(p)
+        assert sorted(back) == sorted(w)
+        for k in w:
+            assert len(back[k]) == len(w[k])
+            for a, b in zip(back[k], w[k]):
+                np.testing.assert_array_equal(a, b)
+
     def test_v0_binary_caffemodel_blobs(self):
         """V0-era .caffemodel: weights nested as layers{layer{name=1,
         blobs=50}} (caffe.proto:1473,1515). Hand-encode the wire bytes and

@@ -143,22 +143,35 @@ class LMDBDataset:
             import lmdb
         except ImportError:
             lmdb = None
+        self.env = None
+        self._reader = None
+        self._native = None
         if lmdb is not None:
             self.env = lmdb.open(path, readonly=True, lock=False,
                                  readahead=False, meminit=False)
             with self.env.begin() as txn:
                 self.keys = [k for k, _ in txn.cursor()]
-            self._reader = None
-        else:
-            from .lmdb_io import LMDBReader
-            self.env = None
-            self._reader = LMDBReader(path)
-            self.keys = list(self._reader.keys())
+            return
+        try:  # native C++ mmap cursor when built
+            from .. import native
+            if native.available():
+                self._native = native.NativeLMDB(path)
+                # key-only scan: values stay untouched in the mmap
+                self.keys = [self._native.key(i)
+                             for i in range(len(self._native))]
+                return
+        except (ImportError, ValueError, RuntimeError):
+            self._native = None
+        from .lmdb_io import LMDBReader
+        self._reader = LMDBReader(path)
+        self.keys = list(self._reader.keys())
 
     def __len__(self) -> int:
         return len(self.keys)
 
     def get(self, index: int) -> tuple[np.ndarray, int]:
+        if self._native is not None:
+            return parse_datum(self._native.value(index))
         if self._reader is not None:
             return parse_datum(self._reader.get(self.keys[index]))
         with self.env.begin() as txn:

@@ -39,6 +39,17 @@ def _load():
         ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
     lib.caffe_tpu_db_close.restype = None
     lib.caffe_tpu_db_close.argtypes = [ctypes.c_void_p]
+    lib.caffe_tpu_lmdb_open.restype = ctypes.c_void_p
+    lib.caffe_tpu_lmdb_open.argtypes = [ctypes.c_char_p]
+    lib.caffe_tpu_lmdb_count.restype = ctypes.c_int64
+    lib.caffe_tpu_lmdb_count.argtypes = [ctypes.c_void_p]
+    lib.caffe_tpu_lmdb_record.restype = ctypes.c_int
+    lib.caffe_tpu_lmdb_record.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64)]
+    lib.caffe_tpu_lmdb_close.restype = None
+    lib.caffe_tpu_lmdb_close.argtypes = [ctypes.c_void_p]
     lib.caffe_tpu_transform_batch.restype = ctypes.c_int
     lib.caffe_tpu_transform_batch.argtypes = [
         ctypes.POINTER(ctypes.c_void_p),          # srcs
@@ -98,6 +109,63 @@ class NativeDatumDB:
     def close(self) -> None:
         if self._h:
             self._lib.caffe_tpu_db_close(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeLMDB:
+    """mmap'd LMDB B+tree reader (lmdb_reader.cc): open walks the tree
+    once into a key-ordered locator table; per-record access is one C
+    call returning pointers into the mapping. data/lmdb_io.py is the
+    behavioral reference and fallback."""
+
+    def __init__(self, path: str):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library not built; run native/build.sh")
+        self._lib = lib
+        self._h = lib.caffe_tpu_lmdb_open(path.encode())
+        if not self._h:
+            raise ValueError(f"{path}: not a readable LMDB (native)")
+        self._n = lib.caffe_tpu_lmdb_count(self._h)
+
+    def __len__(self) -> int:
+        return self._n
+
+    def _locate(self, index: int):
+        kp, vp = ctypes.c_void_p(), ctypes.c_void_p()
+        kl, vl = ctypes.c_int64(), ctypes.c_int64()
+        rc = self._lib.caffe_tpu_lmdb_record(
+            self._h, index, ctypes.byref(kp), ctypes.byref(kl),
+            ctypes.byref(vp), ctypes.byref(vl))
+        if rc != 0:
+            raise IndexError(index)
+        return kp, kl, vp, vl
+
+    def record(self, index: int) -> tuple[bytes, bytes]:
+        kp, kl, vp, vl = self._locate(index)
+        # copies out of the mmap so the bytes outlive close()
+        return (ctypes.string_at(kp, kl.value),
+                ctypes.string_at(vp, vl.value))
+
+    def key(self, index: int) -> bytes:
+        """Key bytes only — never touches (or pages in) the value, so a
+        key scan over a multi-GB DB costs MBs."""
+        kp, kl, _vp, _vl = self._locate(index)
+        return ctypes.string_at(kp, kl.value)
+
+    def value(self, index: int) -> bytes:
+        _kp, _kl, vp, vl = self._locate(index)
+        return ctypes.string_at(vp, vl.value)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.caffe_tpu_lmdb_close(self._h)
             self._h = None
 
     def __del__(self):  # pragma: no cover

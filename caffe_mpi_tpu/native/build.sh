@@ -7,6 +7,6 @@ if command -v cmake >/dev/null 2>&1 && command -v ninja >/dev/null 2>&1; then
   cmake -G Ninja -B build -DCMAKE_BUILD_TYPE=Release >/dev/null
   ninja -C build >/dev/null
 else
-  g++ -O3 -fPIC -shared -std=c++17 -pthread transform.cc datumdb.cc -o libcaffe_tpu_native.so
+  g++ -O3 -fPIC -shared -std=c++17 -pthread transform.cc datumdb.cc lmdb_reader.cc -o libcaffe_tpu_native.so
 fi
 echo "built $(pwd)/libcaffe_tpu_native.so"

@@ -1,0 +1,189 @@
+// Native LMDB reader — mmap'd zero-copy record access, no liblmdb.
+//
+// Counterpart of data/lmdb_io.py's pure-Python reader (the behavioral
+// reference; see its docstring for the on-disk layout: LMDB 0.9 B+tree,
+// struct offsets per mdb.c on LP64). The reference's record path is C++
+// (db_lmdb.cpp over liblmdb); here the format itself is parsed so the
+// hot path — per-record value fetch during training — is one C call
+// handing back a pointer into the mapping, no per-record Python.
+//
+// Open walks the tree once and builds a flat (key, value) locator table
+// in key order; values larger than the node budget resolve through
+// F_BIGDATA overflow pages (data contiguous across pages, so a direct
+// pointer still works). Scope: read-only, single main DB, no DUPSORT —
+// exactly what Caffe datasets are (write-once, unique "%08d..." keys).
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xBEEFC0DE;
+constexpr uint32_t kVersion = 1;
+constexpr uint64_t kInvalid = ~0ULL;
+constexpr int kPageHdr = 16;
+constexpr uint16_t kPBranch = 0x01, kPLeaf = 0x02, kPOverflow = 0x04,
+                   kPMeta = 0x08;
+constexpr uint16_t kFBigData = 0x01;
+
+struct Rec {
+  const uint8_t* key;
+  int64_t klen;
+  const uint8_t* val;
+  int64_t vlen;
+};
+
+struct LmdbDB {
+  const uint8_t* base = nullptr;
+  size_t length = 0;
+  size_t psize = 4096;
+  std::vector<Rec> recs;
+  int fd = -1;
+};
+
+inline uint16_t u16(const uint8_t* p) { uint16_t v; memcpy(&v, p, 2); return v; }
+inline uint32_t u32(const uint8_t* p) { uint32_t v; memcpy(&v, p, 4); return v; }
+inline uint64_t u64(const uint8_t* p) { uint64_t v; memcpy(&v, p, 8); return v; }
+
+// meta page -> (ok, psize, root, txnid)
+bool parse_meta(const uint8_t* base, size_t len, size_t off, size_t* psize,
+                uint64_t* root, uint64_t* txnid) {
+  if (off + 160 > len) return false;
+  const uint8_t* pg = base + off;
+  if (!(u16(pg + 10) & kPMeta)) return false;
+  if (u32(pg + 16) != kMagic || u32(pg + 20) != kVersion) return false;
+  size_t ps = u32(pg + 40);  // mm_dbs[0].md_pad carries the page size
+  *psize = ps ? ps : 4096;
+  if (u16(pg + 88 + 4) != 0) return false;  // main-DB flags must be 0
+  *root = u64(pg + 88 + 40);
+  *txnid = u64(pg + 144);
+  return true;
+}
+
+bool walk(LmdbDB* db, uint64_t pgno, int depth) {
+  if (depth > 64) return false;  // corrupt cycle guard
+  size_t off = pgno * db->psize;
+  if (off + db->psize > db->length) return false;
+  const uint8_t* pg = db->base + off;
+  uint16_t flags = u16(pg + 10);
+  int n = (u16(pg + 12) - kPageHdr) >> 1;
+  if (n < 0) return false;
+  for (int i = 0; i < n; ++i) {
+    uint16_t ptr = u16(pg + kPageHdr + 2 * i);
+    if (off + ptr + 8 > db->length) return false;
+    const uint8_t* node = pg + ptr;
+    uint16_t lo = u16(node), hi = u16(node + 2), nflags = u16(node + 4),
+             ksize = u16(node + 6);
+    if (flags & kPBranch) {
+      uint64_t child =
+          (uint64_t)lo | ((uint64_t)hi << 16) | ((uint64_t)nflags << 32);
+      if (!walk(db, child, depth + 1)) return false;
+    } else if (flags & kPLeaf) {
+      Rec r;
+      // full-extent bounds checks: a truncated/corrupt file must fail
+      // open() with nullptr, not SIGSEGV later in record()
+      if (off + ptr + 8 + (size_t)ksize > db->length) return false;
+      r.key = node + 8;
+      r.klen = ksize;
+      int64_t dsize = (int64_t)lo | ((int64_t)hi << 16);
+      if (nflags & kFBigData) {
+        if (off + ptr + 8 + (size_t)ksize + 8 > db->length) return false;
+        uint64_t ov = u64(node + 8 + ksize);
+        size_t ovoff = ov * db->psize;
+        if (ovoff + kPageHdr + (size_t)dsize > db->length) return false;
+        if (!(u16(db->base + ovoff + 10) & kPOverflow)) return false;
+        r.val = db->base + ovoff + kPageHdr;
+      } else {
+        if (off + ptr + 8 + (size_t)ksize + (size_t)dsize > db->length)
+          return false;
+        r.val = node + 8 + ksize;
+      }
+      r.vlen = dsize;
+      db->recs.push_back(r);
+    } else {
+      return false;
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque handle or nullptr. `path` is the environment dir
+// (containing data.mdb) or the data file itself.
+void* caffe_tpu_lmdb_open(const char* path) {
+  std::string p(path);
+  struct stat st;
+  if (stat(p.c_str(), &st) != 0) return nullptr;
+  if (S_ISDIR(st.st_mode)) {
+    p += "/data.mdb";
+    if (stat(p.c_str(), &st) != 0) return nullptr;
+  }
+  int fd = open(p.c_str(), O_RDONLY);
+  if (fd < 0) return nullptr;
+  void* map = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  if (map == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  auto* db = new LmdbDB;
+  db->base = (const uint8_t*)map;
+  db->length = st.st_size;
+  db->fd = fd;
+
+  size_t ps0 = 0, ps1 = 0;
+  uint64_t root0 = kInvalid, root1 = kInvalid, txn0 = 0, txn1 = 0;
+  bool ok0 = parse_meta(db->base, db->length, 0, &ps0, &root0, &txn0);
+  bool ok1 = ok0 && parse_meta(db->base, db->length, ps0, &ps1, &root1, &txn1);
+  if (!ok0) {
+    munmap(map, st.st_size);
+    close(fd);
+    delete db;
+    return nullptr;
+  }
+  uint64_t root = (ok1 && txn1 > txn0) ? root1 : root0;
+  db->psize = ps0;
+  if (root != kInvalid && !walk(db, root, 0)) {
+    munmap(map, st.st_size);
+    close(fd);
+    delete db;
+    return nullptr;
+  }
+  return db;
+}
+
+int64_t caffe_tpu_lmdb_count(void* h) {
+  return h ? (int64_t)((LmdbDB*)h)->recs.size() : -1;
+}
+
+// Zero-copy pointers into the mapping for record `idx` (key order).
+int caffe_tpu_lmdb_record(void* h, int64_t idx, const uint8_t** key,
+                          int64_t* klen, const uint8_t** val, int64_t* vlen) {
+  if (!h) return -1;
+  auto* db = (LmdbDB*)h;
+  if (idx < 0 || idx >= (int64_t)db->recs.size()) return -1;
+  const Rec& r = db->recs[(size_t)idx];
+  *key = r.key;
+  *klen = r.klen;
+  *val = r.val;
+  *vlen = r.vlen;
+  return 0;
+}
+
+void caffe_tpu_lmdb_close(void* h) {
+  if (!h) return;
+  auto* db = (LmdbDB*)h;
+  if (db->base) munmap((void*)db->base, db->length);
+  if (db->fd >= 0) close(db->fd);
+  delete db;
+}
+
+}  // extern "C"

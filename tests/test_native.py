@@ -83,6 +83,46 @@ class TestNativeTransform:
         got, lab = ds.get(3)
         np.testing.assert_array_equal(got, recs[3][0])
 
+    def test_native_lmdb_matches_python_reader(self, rng, tmp_path):
+        """The C++ LMDB cursor (lmdb_reader.cc) must agree record-for-
+        record with the pure-Python reader (lmdb_io.py, the behavioral
+        reference), including F_BIGDATA overflow values."""
+        from caffe_mpi_tpu import native
+        from caffe_mpi_tpu.data.lmdb_io import LMDBReader, write_lmdb
+        if not native.available():
+            pytest.skip("native library not built")
+        items = [(f"{i:08d}".encode(),
+                  rng.bytes(50 if i % 7 else 5000))  # some overflow values
+                 for i in range(400)]
+        path = str(tmp_path / "db")
+        write_lmdb(path, items)
+        nat = native.NativeLMDB(path)
+        with LMDBReader(path) as py:
+            assert len(nat) == len(py) == 400
+            py_items = list(py.items())
+            for i in range(400):
+                assert nat.record(i) == py_items[i], i
+        nat.close()
+
+    def test_native_lmdb_dataset_path(self, rng, tmp_path):
+        """LMDBDataset routes through the native cursor when the lmdb
+        module is absent and the .so is built."""
+        from caffe_mpi_tpu import native
+        from caffe_mpi_tpu.data.datasets import LMDBDataset, encode_datum
+        from caffe_mpi_tpu.data.lmdb_io import write_lmdb
+        if not native.available():
+            pytest.skip("native library not built")
+        imgs = rng.randint(0, 256, (6, 3, 4, 4)).astype(np.uint8)
+        path = str(tmp_path / "db")
+        write_lmdb(path, [(f"{i:08d}".encode(), encode_datum(imgs[i], i))
+                          for i in range(6)])
+        ds = LMDBDataset(path)
+        assert ds._native is not None  # native path engaged
+        for i in range(6):
+            arr, lab = ds.get(i)
+            np.testing.assert_array_equal(arr, imgs[i])
+            assert lab == i
+
     def test_feeder_uses_native(self, rng):
         ds = SyntheticDataset(64, shape=(3, 16, 16))
         tp = TransformationParameter.from_text(

@@ -151,6 +151,49 @@ class TestTransformerLM:
         assert acc >= 0.9, acc
 
 
+class TestSequenceBf16:
+    def test_transformer_block_under_float16_policy(self, rng):
+        """Attention/LayerNorm/MoE under default_forward_type FLOAT16
+        (bf16 on TPU): activations run bf16, loss stays finite, and the
+        bf16 forward tracks the f32 one."""
+        net_text = """
+        default_forward_type: FLOAT16
+        default_backward_type: FLOAT16
+        layer { name: "in" type: "Input" top: "x" top: "t"
+                input_param { shape { dim: 2 dim: 8 dim: 16 }
+                              shape { dim: 2 dim: 8 } } }
+        layer { name: "ln" type: "LayerNorm" bottom: "x" top: "h1" }
+        layer { name: "attn" type: "Attention" bottom: "h1" top: "h2"
+                attention_param { num_heads: 2 causal: true } }
+        layer { name: "moe" type: "MoE" bottom: "h2" top: "h3"
+                moe_param { num_experts: 2 hidden_dim: 32
+                            capacity_factor: 8.0 } }
+        layer { name: "ip" type: "InnerProduct" bottom: "h3" top: "y"
+                inner_product_param { num_output: 4 axis: 2
+                  weight_filler { type: "xavier" } } }
+        layer { name: "loss" type: "SoftmaxWithLoss" bottom: "y" bottom: "t"
+                top: "l" softmax_param { axis: 2 } }
+        """
+        from caffe_mpi_tpu.net import Net
+        net16 = Net(NetParameter.from_text(net_text), phase="TRAIN")
+        net32 = Net(NetParameter.from_text(
+            net_text.replace("default_forward_type: FLOAT16\n", "")
+                    .replace("default_backward_type: FLOAT16\n", "")),
+            phase="TRAIN")
+        p, s = net16.init(jax.random.PRNGKey(0))
+        p32, s32 = net32.init(jax.random.PRNGKey(0))
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 8, 16)
+                        .astype(np.float32))
+        t = jnp.asarray(np.random.RandomState(1).randint(0, 4, (2, 8)))
+        blobs16, _, l16 = net16.apply(p, s, {"x": x, "t": t}, train=True,
+                                      rng=jax.random.PRNGKey(2))
+        blobs32, _, l32 = net32.apply(p32, s32, {"x": x, "t": t},
+                                      train=True, rng=jax.random.PRNGKey(2))
+        assert blobs16["h2"].dtype == jnp.bfloat16
+        assert np.isfinite(float(l16))
+        np.testing.assert_allclose(float(l16), float(l32), rtol=0.05)
+
+
 class TestMoELayer:
     TEXT = ('name: "moe" type: "MoE" bottom: "x" top: "y" top: "aux"\n'
             'loss_weight: 0 loss_weight: 0.01\n'

@@ -1,0 +1,52 @@
+"""summarize — tabular net structure listing from a prototxt.
+
+Reference: tools/extra/summarize.py (concise per-layer table to check at a
+glance that the specified computation is the expected one). This version
+additionally BUILDS the net, so it reports real output shapes and
+parameter counts (the reference prints only declared fields).
+
+Usage:
+    python -m caffe_mpi_tpu.tools.summarize NET.prototxt [-phase TRAIN|TEST]
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="summarize")
+    p.add_argument("model")
+    p.add_argument("-phase", "--phase", default="TRAIN",
+                   choices=["TRAIN", "TEST"])
+    args = p.parse_args(argv)
+
+    from ..net import Net
+    from ..proto import NetParameter
+    from ..utils.flops import layer_macs_per_image
+
+    net = Net(NetParameter.from_file(args.model), phase=args.phase)
+    total_params = 0
+    total_macs = 0
+    print(f"{'layer':<28}{'type':<18}{'top shape':<22}"
+          f"{'params':>12}{'MMACs/img':>12}")
+    for layer in net.layers:
+        shape = ("x".join(str(d) for d in layer.out_shapes[0])
+                 if layer.out_shapes else "-")
+        n_params = sum(math.prod(d.shape) for d in layer.params.values())
+        macs = layer_macs_per_image(layer)
+        total_params += n_params
+        total_macs += macs
+        print(f"{layer.name:<28}{layer.lp.type:<18}{shape:<22}"
+              f"{n_params or '-':>12}"
+              f"{f'{macs / 1e6:.1f}' if macs else '-':>12}")
+    print(f"\n{len(net.layers)} layers | {total_params:,} params "
+          f"({total_params * 4 / 2**20:.1f} MiB f32) | "
+          f"{2 * total_macs / 1e9:.2f} GFLOPs/img forward")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

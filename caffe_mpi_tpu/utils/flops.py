@@ -18,20 +18,37 @@ import math
 
 
 def layer_macs_per_image(layer) -> int:
-    """Multiply-accumulates per image for one layer (0 for non-MXU ops)."""
+    """Multiply-accumulates per image/sample for one layer (0 for
+    non-MXU ops)."""
     t = layer.type_name
-    if t not in ("Convolution", "Deconvolution", "InnerProduct"):
-        return 0
-    wsize = math.prod(layer.params["weight"].shape)
     if t == "Convolution":
         # weight (Cout, Cin/g, kh, kw); each output position costs
         # Cin/g*kh*kw MACs for each of Cout channels = weight.size
         _, _, oh, ow = layer.out_shapes[0]
-        return wsize * oh * ow
+        return math.prod(layer.params["weight"].shape) * oh * ow
     if t == "Deconvolution":
         _, _, ih, iw = layer.in_shapes[0]
-        return wsize * ih * iw
-    return wsize
+        return math.prod(layer.params["weight"].shape) * ih * iw
+    if t == "InnerProduct":
+        # with axis > 1 the matmul applies per position: (N, *lead, K) ->
+        # (N, *lead, out); MACs scale by the positions per sample
+        positions = math.prod(layer.out_shapes[0][1:-1]) \
+            if len(layer.out_shapes[0]) > 2 else 1
+        return math.prod(layer.params["weight"].shape) * positions
+    if t == "Attention":
+        # per sample: QKV proj S*3C^2 + scores S^2*C + PV S^2*C
+        # + out proj S*C^2  =  4*S*C^2 + 2*S^2*C
+        _, s, c = layer.in_shapes[0]
+        return 4 * s * c * c + 2 * s * s * c
+    if t == "MoE":
+        # per token: gate C*E + top_k expert FFNs (C*H + H*C)
+        shape = layer.in_shapes[0]
+        tokens = math.prod(shape[1:-1]) if len(shape) > 2 else 1
+        c = shape[-1]
+        e, _, h = layer.params["w1"].shape
+        k = max(layer.p.top_k, 1)
+        return tokens * (c * e + k * 2 * c * h)
+    return 0
 
 
 def net_macs_per_image(net) -> int:

@@ -56,6 +56,42 @@ class TestCLI:
         assert main(["train", "-solver", solver_file, "-synthetic",
                      "-gpu", "all"]) == 0
 
+    def test_train_gpu_all_with_lmdb(self, tmp_path, monkeypatch):
+        """The reference's flagship scenario end to end: a DB-backed Data
+        layer feeding data-parallel training over every device of the mesh
+        (LMDB -> Feeder rank striping -> batch sharded over 'data' ->
+        XLA gradient allreduce), via 'caffe train -gpu all'."""
+        import jax.numpy as jnp
+        from caffe_mpi_tpu.data.datasets import encode_datum
+        from caffe_mpi_tpu.data.lmdb_io import write_lmdb
+        rng = np.random.RandomState(0)
+        tmpl = rng.randint(0, 256, (2, 1, 6, 6))
+        labels = rng.randint(0, 2, 64)
+        imgs = np.clip(tmpl[labels] + rng.randint(-30, 31, (64, 1, 6, 6)),
+                       0, 255).astype(np.uint8)
+        db = str(tmp_path / "train_lmdb")
+        write_lmdb(db, [(f"{i:08d}".encode(), encode_datum(imgs[i],
+                                                           int(labels[i])))
+                        for i in range(64)])
+        (tmp_path / "net.prototxt").write_text(f"""
+        name: "dp_lmdb"
+        layer {{ name: "data" type: "Data" top: "data" top: "label"
+                data_param {{ source: "{db}" backend: LMDB batch_size: 16 }}
+                transform_param {{ scale: 0.00390625 }} }}
+        layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "y"
+                inner_product_param {{ num_output: 2
+                  weight_filler {{ type: "xavier" }} }} }}
+        layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "y"
+                bottom: "label" top: "l" }}
+        """)
+        (tmp_path / "solver.prototxt").write_text(
+            f'net: "{tmp_path}/net.prototxt"\nbase_lr: 0.5\n'
+            'lr_policy: "fixed"\nmax_iter: 20\ndisplay: 0\ntype: "SGD"\n'
+            f'snapshot: 20\nsnapshot_prefix: "{tmp_path}/dp"\n')
+        assert main(["train", "-solver", str(tmp_path / "solver.prototxt"),
+                     "-gpu", "all"]) == 0
+        assert (tmp_path / "dp_iter_20.caffemodel").exists()
+
     def test_test_with_weights(self, solver_file, model, tmp_path, capsys):
         main(["train", "-solver", solver_file, "-synthetic"])
         rc = main(["test", "-model", model,

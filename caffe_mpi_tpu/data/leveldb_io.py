@@ -417,8 +417,9 @@ class LevelDBReader:
 
     def get(self, key: bytes):
         import bisect
-        i = bisect.bisect_left(self._records, (key,),
-                               key=lambda r: (r[0],))
+        # (key,) sorts strictly before (key, loc) — tuple comparison by
+        # prefix — so no `key=` kwarg is needed (that kwarg is 3.10+).
+        i = bisect.bisect_left(self._records, (key,))
         if i < len(self._records) and self._records[i][0] == key:
             return self._value(self._records[i][1])
         return None

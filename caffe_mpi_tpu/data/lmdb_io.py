@@ -148,6 +148,13 @@ class LMDBReader:
             ooff, oflags, olower, oupper = self._page(ovpgno)
             if not oflags & P_OVERFLOW:
                 raise LMDBError(f"page {ovpgno} is not an overflow page")
+            # The value may span several overflow pages; _page() only
+            # validated the first one (lmdb_reader.cc checks the full
+            # extent the same way).
+            if ooff + PAGEHDRSZ + dsize > len(self._view):
+                raise LMDBError(
+                    f"overflow value at page {ovpgno} extends beyond EOF "
+                    f"in {self.path}")
             return bytes(self._view[ooff + PAGEHDRSZ:
                                     ooff + PAGEHDRSZ + dsize])
         return bytes(self._view[doff: doff + dsize])

@@ -78,6 +78,7 @@ def moe_ffn(params: dict, x: jnp.ndarray, *, top_k: int = 1,
 
     # top-k routing with per-expert position assignment
     combine = jnp.zeros((t, e, cap), x.dtype)
+    dispatch_m = jnp.zeros((t, e, cap), bool)
     mask_so_far = jnp.zeros((t, e), bool)
     counts = jnp.zeros((e,), jnp.int32)
     for _ in range(top_k):
@@ -87,14 +88,16 @@ def moe_ffn(params: dict, x: jnp.ndarray, *, top_k: int = 1,
         pos = counts[None, :] + jnp.cumsum(onehot, axis=0) - onehot  # (T,E)
         keep = (onehot > 0) & (pos < cap)
         gate_w = jnp.take_along_axis(probs, choice[:, None], axis=1)[:, 0]
-        combine = combine + (
-            keep[:, :, None]
-            * jax.nn.one_hot(pos, cap, dtype=x.dtype)
-            * gate_w[:, None, None])
+        slot = keep[:, :, None] * jax.nn.one_hot(pos, cap, dtype=x.dtype)
+        combine = combine + slot * gate_w[:, None, None]
+        # Dispatch comes from the routing decision itself, not from
+        # thresholding combine: a routed token whose gate weight
+        # underflows to 0 in low precision must still reach its expert.
+        dispatch_m = dispatch_m | (slot > 0)
         counts = counts + jnp.sum(onehot * keep, axis=0)
         mask_so_far = mask_so_far | (onehot > 0)
 
-    dispatch = (combine > 0).astype(x.dtype)          # (T, E, C)
+    dispatch = dispatch_m.astype(x.dtype)             # (T, E, C)
 
     def ep(v, spec):
         if mesh is not None:

@@ -168,6 +168,22 @@ class TestLMDB:
                  for i, sz in enumerate([10, 3000, 5000, 100, 4096, 9000])]
         self._roundtrip(tmp_path, items)
 
+    def test_truncated_overflow_value_raises(self, tmp_path):
+        """A multi-page overflow value in a truncated file must raise, not
+        silently return clamped bytes (mirrors lmdb_reader.cc's
+        full-extent check)."""
+        from caffe_mpi_tpu.data.lmdb_io import LMDBError, LMDBReader, \
+            write_lmdb
+        path = str(tmp_path / "db")
+        big = bytes(range(256)) * 64              # 16KB -> several pages
+        write_lmdb(path, [(b"big", big), (b"tiny", b"v")])
+        data = tmp_path / "db" / "data.mdb"
+        # chop the tail of the overflow chain but keep the meta/leaf pages
+        data.write_bytes(data.read_bytes()[:-8192])
+        with LMDBReader(path) as r:
+            with pytest.raises(LMDBError, match="beyond EOF"):
+                r.get(b"big")
+
     def test_empty_db(self, tmp_path):
         from caffe_mpi_tpu.data.lmdb_io import LMDBReader, write_lmdb
         path = str(tmp_path / "db")

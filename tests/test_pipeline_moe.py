@@ -155,6 +155,25 @@ class TestMoE:
         assert nonzero.sum() == 4
         assert nonzero[:4].all()  # first-come-first-served positions
 
+    def test_underflowed_gate_weight_still_dispatches(self):
+        """A routed, within-capacity token whose softmax gate weight
+        underflows to exactly 0 must still be dispatched (it shows up in
+        the aux loss's frac_tokens): dispatch derives from the routing
+        decision, not from thresholding the gate-weighted combine."""
+        params = init_moe_params(jax.random.PRNGKey(0), 2, 4, 2)
+        # logits = x @ gate; craft gate so logits are [x0, -x0]
+        params["gate"] = jnp.array([[1.0, -1.0], [0.0, 0.0]])
+        x = jnp.zeros((8, 2))
+        # tokens 4-7: logits (120, -120) -> P(expert1) = e^-240 == 0.0 in f32
+        x = x.at[4:, 0].set(120.0)
+        assert float(jax.nn.softmax(jnp.array([120.0, -120.0]))[1]) == 0.0
+        _, aux = moe_ffn(params, x, top_k=2, capacity_factor=8.0)
+        # every token dispatches to BOTH experts -> frac_tokens = [1, 1];
+        # frac_probs = [0.75, 0.25] -> aux = (0.75 + 0.25) * 2 = 2.
+        # Thresholding combine would drop tokens 4-7 from expert 1
+        # (frac_tokens[1] = 0.5 -> aux = 1.75).
+        np.testing.assert_allclose(float(aux), 2.0, rtol=1e-5)
+
     def test_gradients_flow_and_aux_balances(self):
         params = init_moe_params(jax.random.PRNGKey(0), 16, 32, 4)
         x = jax.random.normal(jax.random.PRNGKey(1), (64, 16))

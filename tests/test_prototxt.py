@@ -362,3 +362,42 @@ class TestFiltering:
         assert d.transform_param.mean_file == "m.binaryproto"
         assert d.transform_param.crop_size == 27
         assert d.transform_param.mirror is True
+
+
+class TestUpgradeToolShims:
+    """The explicit migration entry points the reference ships as
+    standalone binaries (tools/upgrade_net_proto_binary.cpp,
+    tools/upgrade_solver_proto_text.cpp). The library migrates on every
+    load; these tools exist for offline, file-to-file conversion."""
+
+    def test_upgrade_net_proto_binary(self, tmp_path):
+        import numpy as np
+        from caffe_mpi_tpu.io import _fields, _tag, _varint, encode_blob, \
+            load_caffemodel
+        from caffe_mpi_tpu.tools.upgrade_net_proto_binary import main
+        w = np.arange(12, dtype=np.float32).reshape(3, 4)
+        blob = encode_blob(w)
+        # V1 encoding: layers (field 2) { name=4, blobs=6 }
+        v1 = (_tag(4, 2) + _varint(len(b"ip")) + b"ip"
+              + _tag(6, 2) + _varint(len(blob)) + blob)
+        src = tmp_path / "old.caffemodel"
+        src.write_bytes(_tag(2, 2) + _varint(len(v1)) + bytes(v1))
+        dst = tmp_path / "new.caffemodel"
+        assert main([str(src), str(dst)]) == 0
+        # output uses only the modern `layer` field (100)
+        fields = {f for f, _, _ in _fields(dst.read_bytes())}
+        assert 100 in fields and 2 not in fields
+        out = load_caffemodel(str(dst))
+        np.testing.assert_array_equal(out["ip"][0], w)
+
+    def test_upgrade_solver_proto_text(self, tmp_path):
+        from caffe_mpi_tpu.proto import SolverParameter
+        from caffe_mpi_tpu.tools.upgrade_solver_proto_text import main
+        src = tmp_path / "old_solver.prototxt"
+        src.write_text('net: "train.prototxt"\nbase_lr: 0.01\n'
+                       "solver_type: NESTEROV\n")
+        dst = tmp_path / "new_solver.prototxt"
+        assert main([str(src), str(dst)]) == 0
+        sp = SolverParameter.from_file(str(dst))
+        assert sp.type == "Nesterov"
+        assert "solver_type" not in dst.read_text()

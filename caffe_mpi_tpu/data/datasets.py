@@ -24,6 +24,8 @@ from typing import Protocol
 
 import numpy as np
 
+from ..io import _tag as _dfield, _varint as _dvarint
+
 
 class Dataset(Protocol):
     def __len__(self) -> int: ...
@@ -102,29 +104,35 @@ def parse_datum(buf: bytes) -> tuple[np.ndarray, int]:
     return arr, label
 
 
+def _datum_header(c: int, h: int, w: int) -> bytearray:
+    out = bytearray()
+    out += _dfield(1, 0) + _dvarint(c)
+    out += _dfield(2, 0) + _dvarint(h)
+    out += _dfield(3, 0) + _dvarint(w)
+    return out
+
+
 def encode_datum(arr: np.ndarray, label: int) -> bytes:
     """Write a raw-bytes Datum (tools/convert_imageset parity, unencoded)."""
     c, h, w = arr.shape
-    out = bytearray()
-
-    def varint(v: int) -> bytes:
-        b = bytearray()
-        while True:
-            if v < 0x80:
-                b.append(v)
-                return bytes(b)
-            b.append((v & 0x7F) | 0x80)
-            v >>= 7
-
-    def field(num: int, wire: int) -> bytes:
-        return varint((num << 3) | wire)
-
-    out += field(1, 0) + varint(c)
-    out += field(2, 0) + varint(h)
-    out += field(3, 0) + varint(w)
+    out = _datum_header(c, h, w)
     raw = arr.astype(np.uint8).tobytes()
-    out += field(4, 2) + varint(len(raw)) + raw
-    out += field(5, 0) + varint(label if label >= 0 else label + (1 << 64))
+    out += _dfield(4, 2) + _dvarint(len(raw)) + raw
+    out += _dfield(5, 0) + _dvarint(label if label >= 0
+                                    else label + (1 << 64))
+    return bytes(out)
+
+
+def encode_datum_float(arr: np.ndarray, label: int) -> bytes:
+    """Datum carrying packed float_data (field 6) — the reference's float
+    path (caffe.proto Datum.float_data, written by e.g. HDF5->datum
+    converters and feature dumps)."""
+    c, h, w = arr.shape
+    out = _datum_header(c, h, w)
+    raw = np.ascontiguousarray(arr, "<f4").tobytes()
+    out += _dfield(6, 2) + _dvarint(len(raw)) + raw
+    out += _dfield(5, 0) + _dvarint(label if label >= 0
+                                    else label + (1 << 64))
     return bytes(out)
 
 

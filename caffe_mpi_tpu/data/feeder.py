@@ -33,11 +33,16 @@ class Feeder:
                  batch_size: int, *, rank: int = 0, world: int = 1,
                  shuffle: bool = False, seed: int = 0, threads: int = 2,
                  lookahead: int = 3, to_device=None,
-                 top_names: tuple[str, str] = ("data", "label")):
+                 top_names: tuple[str, str] = ("data", "label"),
+                 device_transform: bool = False):
         """to_device: optional callable(feeds_dict) -> feeds_dict placing
         arrays (e.g. MeshPlan.shard_feeds); applied on the consumer side.
         top_names: blob names for the (image, label) tops — from the data
-        layer's prototxt `top:` entries."""
+        layer's prototxt `top:` entries.
+        device_transform: stage raw uint8 batches + per-record aug
+        decisions instead of transforming on the host — must match the
+        consuming Net's DataLayer.dev_transform (the CLI binds both from
+        the net; see layers/data_layers.py)."""
         self.top_names = top_names
         self.ds = dataset
         self.tf = transformer
@@ -49,6 +54,7 @@ class Feeder:
         self.lookahead = max(lookahead, 1)
         self.to_device = to_device
         self.threads = max(threads, 1)
+        self.device_transform = device_transform
         # native C++ transform path: engaged when built and the transform is
         # expressible there (no force_color/gray); per-batch uniform-shape
         # uint8 checked at run time, python path as fallback
@@ -93,11 +99,28 @@ class Feeder:
             labels.append(label)
             flats.append(it * self.batch * self.world
                          + self.rank * self.batch + slot)
-        batch = self._transform(raws, flats)
-        out = {self.top_names[0]: batch}
+        if self.device_transform:
+            out = self._raw_batch(raws, flats)
+        else:
+            out = {self.top_names[0]: self._transform(raws, flats)}
         if len(self.top_names) > 1:
             out[self.top_names[1]] = np.asarray(labels, np.int32)
         return out
+
+    def _raw_batch(self, raws: list[np.ndarray], flats: list[int]) -> dict:
+        """Device-transform staging: uint8 stack + (B,3) aug decisions
+        (same per-record Philox streams as the host transform)."""
+        from .device_transform import aug_key, compute_aug
+        first = raws[0]
+        if first.dtype != np.uint8 or any(
+                r.shape != first.shape or r.dtype != np.uint8 for r in raws):
+            raise ValueError(
+                "device transform requires uniform uint8 records; set "
+                "transform_param { use_gpu_transform: false } for this "
+                "dataset")
+        aug = compute_aug(self.tf, flats, first.shape[-2:], len(raws))
+        return {self.top_names[0]: np.stack(raws),
+                aug_key(self.top_names[0]): aug}
 
     def _transform(self, raws: list[np.ndarray], flats: list[int]) -> np.ndarray:
         tf = self.tf
@@ -142,10 +165,12 @@ class Feeder:
 
 
 def feeder_from_layer(lp, phase: str, *, rank: int = 0, world: int = 1,
-                      model_dir: str = "") -> Feeder:
+                      model_dir: str = "",
+                      device_transform: bool = False) -> Feeder:
     """Build a Feeder from a Data/ImageData layer's prototxt config — the
     runner-side binding for DB-backed layers (reference
-    DataLayer::LayerSetUp, data_layer.cpp:118-180)."""
+    DataLayer::LayerSetUp, data_layer.cpp:118-180). device_transform must
+    be the consuming net's DataLayer.dev_transform."""
     import os
 
     from .datasets import ImageFolderDataset, open_dataset
@@ -162,7 +187,8 @@ def feeder_from_layer(lp, phase: str, *, rank: int = 0, world: int = 1,
         shuffle = bool(p.shuffle) and phase == "TRAIN"
         return Feeder(ds, tf, p.batch_size, rank=rank, world=world,
                       shuffle=shuffle, top_names=tops,
-                      threads=p.threads or 2)
+                      threads=p.threads or 2,
+                      device_transform=device_transform)
     if lp.type == "ImageData":
         p = lp.image_data_param
         ds = ImageFolderDataset(os.path.join(model_dir, p.source),
@@ -175,10 +201,25 @@ def feeder_from_layer(lp, phase: str, *, rank: int = 0, world: int = 1,
     raise ValueError(f"not a pipeline data layer: {lp.type}")
 
 
+class ProbeShape(tuple):
+    """Post-transform (C,H,W) that also remembers the raw record shape —
+    the device-transform path needs both (the feed is the raw uint8
+    record; the top blob is the transformed shape)."""
+
+    raw: tuple | None = None
+
+    def __new__(cls, shape, raw=None):
+        self = super().__new__(cls, shape)
+        self.raw = raw
+        return self
+
+
 def data_shape_probe(lp, model_dir: str = ""):
     """Open the dataset once to discover record shape, returning the
     post-transform (C,H,W) — the Net-side binding for Data layers
-    (reference: DataLayer reads one sample in LayerSetUp)."""
+    (reference: DataLayer reads one sample in LayerSetUp). For uniform
+    uint8 datasets the result carries `.raw`, enabling the in-graph
+    transform path."""
     import os as _os
 
     from .datasets import open_dataset
@@ -188,7 +229,19 @@ def data_shape_probe(lp, model_dir: str = ""):
                           _os.path.join(model_dir, lp.data_param.source))
         img, _ = ds.get(0)
         tf = DataTransformer(lp.transform_param, "TEST", model_dir=model_dir)
-        return tf.output_shape(img.shape)
+        raw = tuple(img.shape) if img.dtype == np.uint8 else None
+        if raw is not None:
+            # the in-graph transform needs a uniform record shape; sample
+            # records spread across the DB (a full scan would read the
+            # whole dataset) — mixed-size layouts fall back to the host
+            # path, which crops every record to a common shape
+            n = len(ds)
+            for i in {n // 2, n - 1, *range(1, min(n, 8))}:
+                rec, _ = ds.get(int(i))
+                if rec.shape != img.shape or rec.dtype != np.uint8:
+                    raw = None
+                    break
+        return ProbeShape(tf.output_shape(img.shape), raw=raw)
     if lp.type == "HDF5Data":
         import h5py
         src = _os.path.join(model_dir, lp.hdf5_data_param.source)

@@ -28,6 +28,30 @@ class InputLayerBase(Layer):
     def feed_shapes(self) -> list[Shape]:
         return self.out_shapes
 
+    def feed_specs(self) -> list[tuple[str, Shape, str]]:
+        """The host feed contract: [(feed key, shape, kind)] with kind in
+        {"float", "int", "uint8", "aug"} — synthetic-feed generators and
+        the dryrun build inputs from this. Default: one float blob per
+        top. Device-transform data layers override (raw uint8 + aug)."""
+        return [(t, s, "float")
+                for t, s in zip(self.lp.top, self.out_shapes)]
+
+    def gather_feeds(self, feeds: dict) -> list:
+        """Pull + validate this layer's feeds; returns apply() bottoms."""
+        bottoms = []
+        for key, shape, _kind in self.feed_specs():
+            try:
+                v = feeds[key]
+            except KeyError:
+                raise KeyError(
+                    f"input layer {self.name!r}: missing feed for blob "
+                    f"{key!r}") from None
+            if tuple(v.shape) != tuple(shape):
+                raise ValueError(
+                    f"feed {key!r}: shape {v.shape} != declared {shape}")
+            bottoms.append(v)
+        return bottoms
+
     def apply(self, params, state, bottoms, *, train, rng):
         # bottoms here are the fed arrays, passed through (cast to policy)
         return [self.f(b) if jnp.issubdtype(b.dtype, jnp.floating) else b
@@ -107,11 +131,22 @@ class PipelineDataLayer(InputLayerBase):
 class DataLayer(PipelineDataLayer):
     """LMDB/LevelDB-backed (data_layer.cpp). Shape comes from the dataset at
     pipeline bind time; setup uses declared/transform dims with a dataset
-    probe done by the runner (set via `bind_shape`)."""
+    probe done by the runner (set via `bind_shape`).
+
+    Device-side transform (data_transformer.cu / use_gpu_transform,
+    base_data_layer.hpp:111-116): when the probe reports uniform uint8
+    records and the transform is expressible in-graph, the feed contract
+    becomes {top0: raw uint8 (B,C,H,W), top0+"__aug": (B,3) int32} and
+    crop/mean/mirror/scale run inside the jitted step (default ON; opt
+    out with transform_param { use_gpu_transform: false }). The net-side
+    builder may veto via `allow_device_transform` (pycaffe's manual-feed
+    surface does)."""
 
     bound_shape: tuple | None = None
+    allow_device_transform: bool = True
 
     def setup(self, in_shapes: list[Shape]) -> list[Shape]:
+        from ..data.device_transform import wants_device_transform
         p = self.lp.data_param
         if self.bound_shape is None:
             raise ValueError(
@@ -119,7 +154,46 @@ class DataLayer(PipelineDataLayer):
                 "runner must set layer.bound_shape = (C, H, W) before setup"
             )
         c, h, w = self.bound_shape
+        # raw (pre-transform) record shape, reported by the default probe
+        # for uniform uint8 datasets; None disables the device path
+        self.raw_shape = getattr(self.bound_shape, "raw", None)
+        self.dev_transform = bool(
+            self.allow_device_transform and self.raw_shape is not None
+            and wants_device_transform(self.lp))
+        if self.dev_transform:
+            self._mean = self._load_mean()
         return self._data_shapes(p.batch_size, c, h, w)
+
+    def _load_mean(self):
+        """Mean constant for the in-graph path — same resolution rules as
+        the host DataTransformer (mean_file wins over mean_value)."""
+        from ..data.transformer import DataTransformer
+        return DataTransformer(self.lp.transform_param, self.phase,
+                               model_dir=self.model_dir or "").mean
+
+    def feed_specs(self):
+        if not getattr(self, "dev_transform", False):
+            return super().feed_specs()
+        from ..data.device_transform import AUG_FIELDS, aug_key
+        b = self.lp.data_param.batch_size
+        top0 = self.lp.top[0]
+        specs = [(top0, (b, *self.raw_shape), "uint8"),
+                 (aug_key(top0), (b, AUG_FIELDS), "aug")]
+        for t, s in zip(self.lp.top[1:], self.out_shapes[1:]):
+            specs.append((t, s, "int"))
+        return specs
+
+    def apply(self, params, state, bottoms, *, train, rng):
+        if not getattr(self, "dev_transform", False):
+            return super().apply(params, state, bottoms, train=train, rng=rng)
+        from ..data.device_transform import device_transform
+        raw, aug, *rest = bottoms
+        tp = self.lp.transform_param
+        x = device_transform(raw, aug,
+                             crop=tp.crop_size if tp else 0,
+                             mean=self._mean,
+                             scale=tp.scale if tp else 1.0)
+        return [self.f(x), *rest], state
 
 
 @register("ImageData")

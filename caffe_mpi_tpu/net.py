@@ -46,14 +46,25 @@ class Net:
     def __init__(self, param: NetParameter, phase: str = "TRAIN", *,
                  level: int = 0, stages: Sequence[str] = (),
                  batch_divisor: int = 1,
-                 data_shape_probe=None, model_dir: str = ""):
+                 data_shape_probe=None, model_dir: str = "",
+                 solver_storage: str = "FLOAT",
+                 device_transform: bool | None = None):
         """batch_divisor: divide data-layer batch sizes by the per-replica
         count, reproducing divide_batch_size (reference parallel.cpp:295-348).
         data_shape_probe: callable(layer_param) -> (C,H,W) for DB-backed
         layers whose shape comes from the dataset.
         model_dir: base directory for relative data-source paths (the
         directory of the prototxt, like the reference's working-dir
-        convention)."""
+        convention).
+        solver_storage: the solver's `solver_data_type` (caffe.proto:299) —
+        the storage dtype of learnable params (master weights). FLOAT (f32,
+        the default and the right TPU choice), FLOAT16 (bf16 storage;
+        updates still accumulate in f32 — Solver casts up around the update
+        rule), or DOUBLE (mapped to f32: no f64 MXU path). Integer types
+        are rejected.
+        device_transform: None (auto — in-graph crop/mean/mirror/scale for
+        eligible Data layers, the use_gpu_transform analogue) or False to
+        force the host transform path (manual-feed surfaces: pycaffe)."""
         self.model_dir = model_dir
         param = normalize_net(param)
         state = NetState(phase=phase, level=level, stage=list(stages))
@@ -67,12 +78,19 @@ class Net:
         self._indexed_upto = 0
         self.blob_shapes: dict[str, tuple] = {}
         self.feed_blobs: list[str] = []  # blob names fed from host
+        # actual host-feed contract: key -> (shape, kind); differs from
+        # blob_shapes for device-transform Data layers (raw uint8 + aug)
+        self.feed_specs: dict[str, tuple[tuple, str]] = {}
         self.loss_blobs: list[tuple[str, float]] = []  # (blob, weight)
         # param sharing: ParamSpec.name -> (owner layer, param name)
         self._shared_owner: dict[str, tuple[str, str]] = {}
         self.param_aliases: dict[tuple[str, str], tuple[str, str]] = {}
 
-        solver_storage = "FLOAT"
+        if solver_storage not in ("", "FLOAT", "FLOAT16", "DOUBLE"):
+            raise ValueError(
+                f"unsupported solver_data_type {solver_storage!r}: learnable "
+                "params must be floating point (FLOAT, FLOAT16, or DOUBLE)")
+        solver_storage = solver_storage or "FLOAT"
         for lp in param.layer:
             policy = DtypePolicy.resolve(
                 lp.forward_type, lp.backward_type,
@@ -94,6 +112,7 @@ class Net:
                     probe = lambda lp_: _default_probe(lp_, model_dir)
                 if lp.type == "Data":
                     layer.bound_shape = probe(lp)
+                    layer.allow_device_transform = device_transform is not False
                 else:
                     layer.bound_shapes = probe(lp)
             # resolve bottoms
@@ -119,6 +138,8 @@ class Net:
                 self.blob_shapes[t] = tuple(s)
             if isinstance(layer, InputLayerBase):
                 self.feed_blobs.extend(lp.top)
+                for key, shape, kind in layer.feed_specs():
+                    self.feed_specs[key] = (tuple(shape), kind)
             # loss weights (reference layer.hpp SetLossWeights)
             for ti, t in enumerate(lp.top):
                 w = (lp.loss_weight[ti] if ti < len(lp.loss_weight)
@@ -239,17 +260,7 @@ class Net:
             lparams = self._layer_params(layer, params, train)
             lstate = state.get(layer.name, {})
             if isinstance(layer, InputLayerBase):
-                try:
-                    bottoms = [feeds[t] for t in layer.lp.top]
-                except KeyError as e:
-                    raise KeyError(
-                        f"input layer {layer.name!r}: missing feed for blob {e}"
-                    ) from None
-                for t, shape in zip(layer.lp.top, layer.out_shapes):
-                    if tuple(feeds[t].shape) != tuple(shape):
-                        raise ValueError(
-                            f"feed {t!r}: shape {feeds[t].shape} != declared {shape}"
-                        )
+                bottoms = layer.gather_feeds(feeds)
             else:
                 bottoms = [env[b] for b in layer.lp.bottom]
                 # per-bottom gradient blocking (LayerParameter.propagate_down;

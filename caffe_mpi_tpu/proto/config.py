@@ -735,6 +735,12 @@ class LayerParameter(Message):
     # backward pass (jax.checkpoint) instead of storing them — the
     # HBM-for-FLOPs trade the reference cannot express
     remat: bool = False
+    # TPU-native extension: tensor-parallel placement of this layer's
+    # weights over the mesh 'model' axis. "rows" shards the output dim
+    # (Megatron column-parallel), "cols" the input dim (row-parallel,
+    # XLA inserts the partial-sum all-reduce). Consumed by the Solver
+    # when a mesh with a model axis is active; ignored otherwise.
+    param_sharding: str = ""
 
     transform_param: TransformationParameter | None = None
     loss_param: LossParameter | None = None

@@ -95,7 +95,10 @@ class Net:
             weights, phase = args
         else:
             raise TypeError("Net(model, [weights,] phase)")
-        self._net = _GraphNet(NetParameter.from_file(model_file), phase=phase)
+        # manual-feed surface: users set blobs by name at the net's blob
+        # shapes, so the in-graph transform contract is disabled
+        self._net = _GraphNet(NetParameter.from_file(model_file), phase=phase,
+                              device_transform=False)
         self._params, self._state = self._net.init(jax.random.PRNGKey(0))
         if weights:
             self.copy_from(weights)

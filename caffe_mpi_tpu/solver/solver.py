@@ -94,7 +94,8 @@ class Solver:
         self.net = Net(train_param, phase="TRAIN", batch_divisor=batch_divisor,
                        data_shape_probe=data_shape_probe, model_dir=model_dir,
                        level=tstate.level if tstate else 0,
-                       stages=tuple(tstate.stage) if tstate else ())
+                       stages=tuple(tstate.stage) if tstate else (),
+                       solver_storage=sp.solver_data_type)
         self.test_nets: list[Net] = []
         n_tests = max(len(sp.test_net), len(sp.test_net_param),
                       1 if (sp.net or sp.net_param is not None) and sp.test_iter else 0)
@@ -111,6 +112,8 @@ class Solver:
         self.params, self.net_state = self.net.init(self.base_rng)
         self.opt_state = self._init_opt_state()
         self.mesh = mesh
+        if param_shardings is None and mesh is not None:
+            param_shardings = self._prototxt_shardings() or None
         self._param_shardings = param_shardings
         if param_shardings and mesh is None:
             raise ValueError("param_shardings requires a mesh")
@@ -135,6 +138,28 @@ class Solver:
                  if l2 == ln}
             for ln in {l for (l, _, _) in self.net.learnable_param_decls()}
         }
+
+    def _prototxt_shardings(self) -> dict:
+        """Collect per-layer `param_sharding` declarations from the net
+        prototxt (the TPU extension making tensor parallelism a model
+        property, launchable from one `caffe train -mesh ...` line).
+        "rows" = output dim over 'model' (Megatron column-parallel);
+        "cols" = input dim over 'model' (row-parallel; GSPMD inserts the
+        partial-sum all-reduce)."""
+        rules = {}
+        for layer in self.net.layers:
+            s = getattr(layer.lp, "param_sharding", "")
+            if not s:
+                continue
+            if s == "rows":
+                rules[layer.name] = "rows"
+            elif s == "cols":
+                rules[layer.name] = (None, "model")
+            else:
+                raise ValueError(
+                    f"layer {layer.name!r}: unknown param_sharding {s!r} "
+                    "(expected 'rows' or 'cols')")
+        return rules
 
     def _place_params_opt(self) -> None:
         """(Re)apply mesh placement to params + optimizer slots — used at

@@ -87,16 +87,29 @@ def main(argv=None) -> int:
     p.add_argument("-crop", "--crop", type=int, default=227)
     p.add_argument("-backends", "--backends",
                    default="lmdb,leveldb,datumfile,hdf5")
+    p.add_argument("-device-transform", "--device-transform",
+                   action="store_true",
+                   help="stage raw uint8 + aug decisions (the in-graph "
+                   "transform feed path) instead of transforming on host")
     args = p.parse_args(argv)
     shape = tuple(int(x) for x in args.shape.split("x"))
 
     imgs, labels = _make_records(args.n, shape)
     iters = max(args.n // args.batch, 1)
+    mode = "raw+aug staging" if args.device_transform else "host transform"
     with tempfile.TemporaryDirectory() as workdir:
         for backend in args.backends.split(","):
             t_build = time.perf_counter()
             feeder = _feeder_for(backend, workdir, imgs, labels,
                                  args.batch, args.crop)
+            if args.device_transform:
+                if not hasattr(feeder, "device_transform"):
+                    print(f"{backend:>10}: n/a (no device-transform path)")
+                    close = getattr(feeder, "close", None)
+                    if close:
+                        close()
+                    continue
+                feeder.device_transform = True
             build_s = time.perf_counter() - t_build
             feeder(0)  # warm caches / thread pools
             t0 = time.perf_counter()
@@ -107,7 +120,7 @@ def main(argv=None) -> int:
             if close:
                 close()
             print(f"{backend:>10}: {args.batch * iters / dt:8.0f} img/s "
-                  f"({args.batch}x{args.shape}, crop {args.crop}, "
+                  f"({args.batch}x{args.shape}, crop {args.crop}, {mode}, "
                   f"build {build_s:.1f}s)")
     return 0
 

@@ -36,6 +36,9 @@ def _parser() -> argparse.ArgumentParser:
         ("weights", dict(default="", help=".caffemodel[.h5] to load")),
         ("snapshot", dict(default="", help=".solverstate[.h5|.npz] to resume")),
         ("gpu", dict(default="", help="'all' = full device mesh, or index")),
+        ("mesh", dict(default="", help="explicit mesh shape, e.g. "
+                      "'data=4,model=2'; layers with param_sharding "
+                      "rules go tensor-parallel over 'model'")),
         ("iterations", dict(type=int, default=50)),
         ("sigint_effect", dict(default="stop", choices=["stop", "snapshot", "none"])),
         ("sighup_effect", dict(default="snapshot", choices=["stop", "snapshot", "none"])),
@@ -53,10 +56,24 @@ def _parser() -> argparse.ArgumentParser:
     return p
 
 
-def _select_mesh(gpu_flag: str):
+def _select_mesh(gpu_flag: str, mesh_flag: str = ""):
     """-gpu all => data-parallel mesh over every device (the reference
-    spawns one P2PSync per GPU; here one SPMD program)."""
+    spawns one P2PSync per GPU; here one SPMD program).
+    -mesh data=N,model=M => explicit 2D mesh: batch sharded over 'data',
+    layers with `param_sharding` prototxt rules tensor-parallel over
+    'model' — the one-command analogue of the reference's
+    `mpirun -n N caffe train` line (README.md:40), generalized beyond DP."""
     from ..parallel import MeshPlan
+    if mesh_flag:
+        shape = {"data": 1, "model": 1}
+        for kv in mesh_flag.split(","):
+            k, _, v = kv.partition("=")
+            k = k.strip()
+            if k not in shape or not v.strip().isdigit():
+                raise SystemExit(
+                    f"bad -mesh entry {kv!r}: expected data=N[,model=M]")
+            shape[k] = int(v)
+        return MeshPlan.from_shape(shape["data"], shape["model"])
     if gpu_flag == "all":
         return MeshPlan.data_parallel()
     return None
@@ -80,13 +97,18 @@ def _synthetic_feed(net, seed=0):
                 and len(lp.bottom) > 1:
             int_range.setdefault(lp.bottom[1], 10)
     feeds = {}
-    for blob in net.feed_blobs:
-        shape = net.blob_shapes[blob]
-        if blob in int_range:
-            feeds[blob] = jnp.asarray(
-                r.randint(0, max(int_range[blob], 1), shape))
+    for key, (shape, kind) in net.feed_specs.items():
+        if kind == "uint8":
+            feeds[key] = jnp.asarray(
+                r.randint(0, 256, shape).astype(np.uint8))
+        elif kind == "aug":
+            # zeros = top-left crop, no mirror — always valid offsets
+            feeds[key] = jnp.zeros(shape, jnp.int32)
+        elif key in int_range or kind == "int":
+            feeds[key] = jnp.asarray(
+                r.randint(0, max(int_range.get(key, 10), 1), shape))
         else:
-            feeds[blob] = jnp.asarray(r.randn(*shape).astype(np.float32))
+            feeds[key] = jnp.asarray(r.randn(*shape).astype(np.float32))
     return feeds
 
 
@@ -97,8 +119,9 @@ def _build_feeders(net, phase, rank=0, world=1, model_dir=""):
     model_dir = model_dir or getattr(net, "model_dir", "")
     for layer in net.layers:
         if layer.lp.type in ("Data", "ImageData"):
-            return feeder_from_layer(layer.lp, phase, rank=rank, world=world,
-                                     model_dir=model_dir)
+            return feeder_from_layer(
+                layer.lp, phase, rank=rank, world=world, model_dir=model_dir,
+                device_transform=getattr(layer, "dev_transform", False))
         if layer.lp.type == "HDF5Data":
             return HDF5Feeder(layer.lp, rank=rank, world=world,
                               model_dir=model_dir)
@@ -124,7 +147,8 @@ def cmd_train(args) -> int:
         sp.test_iter = [args.test_iter] * max(len(sp.test_iter), 1)
     model_dir = os.path.dirname(os.path.abspath(args.solver)) \
         if not (sp.net and os.path.exists(sp.net)) else ""
-    solver = Solver(sp, mesh=_select_mesh(args.gpu), model_dir=model_dir,
+    solver = Solver(sp, mesh=_select_mesh(args.gpu, args.mesh),
+                    model_dir=model_dir,
                     data_shape_probe=lambda lp: data_shape_probe(lp, model_dir))
     if args.snapshot:
         solver.restore(args.snapshot)

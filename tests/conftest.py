@@ -32,6 +32,11 @@ assert jax.devices()[0].platform == "cpu", "tests must run on the CPU platform"
 assert len(jax.devices()) == 8, "expected 8 virtual CPU devices"
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running end-to-end tests (full-size models)")
+
+
 @pytest.fixture
 def rng():
     return np.random.RandomState(1701)

@@ -130,3 +130,124 @@ class TestRemat:
         g1, g2 = loss(plain), loss(remat)
         for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
             np.testing.assert_allclose(np.array(a), np.array(b), rtol=1e-5)
+
+
+class TestMeshFlag:
+    """-mesh data=N,model=M + prototxt param_sharding: the one-command
+    DPxTP launch (the `mpirun -n N caffe train` analogue generalized
+    beyond DP, reference README.md:40)."""
+
+    def test_mesh_flag_parses(self):
+        from caffe_mpi_tpu.tools.cli import _select_mesh
+        plan = _select_mesh("", "data=4,model=2")
+        assert dict(plan.mesh.shape) == {"data": 4, "model": 2}
+        plan = _select_mesh("", "data=8")
+        assert dict(plan.mesh.shape) == {"data": 8, "model": 1}
+        for bad in ("data=4,model=x", "foo=8", "data"):
+            with pytest.raises(SystemExit):
+                _select_mesh("", bad)
+        assert _select_mesh("", "") is None
+
+    def test_prototxt_sharding_rules_collected_and_applied(self, tmp_path):
+        """param_sharding: "rows"/"cols" in the net prototxt places the
+        weights over the 'model' axis; training matches the same-mesh
+        replicated (pure-DP) run."""
+        from caffe_mpi_tpu.parallel import MeshPlan
+        from caffe_mpi_tpu.proto import NetParameter, SolverParameter
+        from caffe_mpi_tpu.solver import Solver
+        net_text = """
+        layer { name: "in" type: "Input" top: "x" top: "label"
+                input_param { shape { dim: 16 dim: 32 } shape { dim: 16 } } }
+        layer { name: "fc1" type: "InnerProduct" bottom: "x" top: "h"
+                param_sharding: "rows"
+                inner_product_param { num_output: 64
+                  weight_filler { type: "xavier" } } }
+        layer { name: "relu" type: "ReLU" bottom: "h" top: "h" }
+        layer { name: "fc2" type: "InnerProduct" bottom: "h" top: "y"
+                param_sharding: "cols"
+                inner_product_param { num_output: 10
+                  weight_filler { type: "xavier" } } }
+        layer { name: "loss" type: "SoftmaxWithLoss" bottom: "y"
+                bottom: "label" top: "l" }
+        """
+
+        def run(strip_rules):
+            sp = SolverParameter.from_text(
+                'base_lr: 0.1 lr_policy: "fixed" momentum: 0.9\n'
+                'max_iter: 5 display: 0 random_seed: 3 type: "SGD"')
+            sp.net_param = NetParameter.from_text(net_text)
+            if strip_rules:
+                for lp in sp.net_param.layer:
+                    lp.param_sharding = ""
+            solver = Solver(sp, mesh=MeshPlan.from_shape(4, 2))
+            r = np.random.RandomState(0)
+            feeds = {"x": r.randn(16, 32).astype(np.float32),
+                     "label": r.randint(0, 10, 16)}
+            solver.step(5, lambda it: feeds)
+            return solver
+
+        tp = run(strip_rules=False)
+        assert "model" in str(tp.params["fc1"]["weight"].sharding.spec)
+        assert "model" in str(tp.params["fc2"]["weight"].sharding.spec)
+        # optimizer history follows the param placement
+        assert (tp.opt_state["fc1"]["weight"][0].sharding
+                == tp.params["fc1"]["weight"].sharding)
+        dp = run(strip_rules=True)
+        assert dp.params["fc1"]["weight"].sharding.is_fully_replicated
+        for ln in ("fc1", "fc2"):
+            np.testing.assert_allclose(
+                np.asarray(tp.params[ln]["weight"]),
+                np.asarray(dp.params[ln]["weight"]), atol=1e-5)
+
+    def test_unknown_param_sharding_rejected(self):
+        from caffe_mpi_tpu.parallel import MeshPlan
+        from caffe_mpi_tpu.proto import NetParameter, SolverParameter
+        from caffe_mpi_tpu.solver import Solver
+        sp = SolverParameter.from_text('base_lr: 0.1 lr_policy: "fixed"')
+        sp.net_param = NetParameter.from_text("""
+        layer { name: "in" type: "Input" top: "x"
+                input_param { shape { dim: 8 dim: 4 } } }
+        layer { name: "fc" type: "InnerProduct" bottom: "x" top: "y"
+                param_sharding: "diagonal"
+                inner_product_param { num_output: 8
+                  weight_filler { type: "xavier" } } }
+        """)
+        with pytest.raises(ValueError, match="param_sharding"):
+            Solver(sp, mesh=MeshPlan.from_shape(4, 2))
+
+    @pytest.mark.slow
+    def test_resnet50_cli_mesh_tp_matches_dp(self, tmp_path, monkeypatch):
+        """The north-star launch: `caffe train -mesh data=4,model=2` on
+        ResNet-50 with prototxt TP rules, parameter-trajectory-matching
+        the same-mesh replicated run (float-reassociation tolerance:
+        sharded contractions reduce in a different order)."""
+        import os
+        from caffe_mpi_tpu.io import load_caffemodel
+        from caffe_mpi_tpu.proto import NetParameter
+        monkeypatch.chdir(tmp_path)
+        net = NetParameter.from_file(
+            os.path.join(os.path.dirname(__file__),
+                         "../caffe_mpi_tpu/models/resnet50/train_val.prototxt"))
+        net.layer[0].input_param.shape[0].dim = [8, 3, 48, 48]
+        net.layer[0].input_param.shape[1].dim = [8]
+        for lp in net.layer:
+            if lp.name in ("fc", "conv1"):
+                lp.param_sharding = "rows"
+        (tmp_path / "net_tp.prototxt").write_text(net.to_prototxt())
+        for lp in net.layer:
+            lp.param_sharding = ""
+        (tmp_path / "net_dp.prototxt").write_text(net.to_prototxt())
+        for tag in ("tp", "dp"):
+            (tmp_path / f"solver_{tag}.prototxt").write_text(
+                f'net: "net_{tag}.prototxt"\nbase_lr: 0.001\n'
+                'lr_policy: "fixed"\nmomentum: 0.9\nmax_iter: 2\n'
+                f'display: 0\nsnapshot: 2\nsnapshot_prefix: "{tag}"\n'
+                'type: "SGD"\nrandom_seed: 5\n')
+            assert main(["train", "-solver", str(tmp_path / f"solver_{tag}.prototxt"),
+                         "-mesh", "data=4,model=2", "-synthetic"]) == 0
+        a = load_caffemodel(str(tmp_path / "tp_iter_2.caffemodel"))
+        b = load_caffemodel(str(tmp_path / "dp_iter_2.caffemodel"))
+        assert a.keys() == b.keys()
+        for k in a:
+            for x, y in zip(a[k], b[k]):
+                np.testing.assert_allclose(x, y, atol=5e-3)

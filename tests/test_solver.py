@@ -313,3 +313,43 @@ class TestEndToEnd:
             np.asarray(solver.opt_state["ip"]["weight"][0]), hw, rtol=1e-6)
         np.testing.assert_allclose(
             np.asarray(solver.opt_state["ip"]["bias"][0]), hb, rtol=1e-6)
+
+
+class TestSolverDataType:
+    """solver_data_type (caffe.proto:299) selects master-weight storage.
+    FLOAT16 -> bf16 storage with f32 update accumulation (the step casts
+    up around the update rule and optimizer history stays f32); integer
+    types are rejected at net build."""
+
+    def test_float16_storage_trains(self, rng):
+        solver = make_solver('type: "SGD" momentum: 0.9\n'
+                             'solver_data_type: FLOAT16')
+        assert solver.params["ip"]["weight"].dtype == jnp.bfloat16
+        for slots in (solver.opt_state["ip"]["weight"],
+                      solver.opt_state["ip"]["bias"]):
+            assert all(s.dtype == jnp.float32 for s in slots)
+        feeds = lsq_feeds(rng)
+        losses = [solver.step(1, lambda it: feeds) for _ in range(15)]
+        assert solver.params["ip"]["weight"].dtype == jnp.bfloat16
+        assert all(s.dtype == jnp.float32
+                   for s in solver.opt_state["ip"]["weight"])
+        assert losses[-1] < losses[0] * 0.5
+
+    def test_float16_snapshot_roundtrip(self, rng, tmp_path):
+        solver = make_solver('type: "SGD"\nsolver_data_type: FLOAT16')
+        feeds = lsq_feeds(rng)
+        solver.step(3, lambda it: feeds)
+        w = solver.net.export_weights(solver.params, solver.net_state)
+        p2, _ = solver.net.import_weights(solver.params, solver.net_state, w)
+        assert p2["ip"]["weight"].dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(p2["ip"]["weight"], np.float32),
+            np.asarray(solver.params["ip"]["weight"], np.float32))
+
+    def test_integer_type_rejected(self):
+        with pytest.raises(ValueError, match="solver_data_type"):
+            make_solver("solver_data_type: INT")
+
+    def test_double_maps_to_f32(self):
+        solver = make_solver("solver_data_type: DOUBLE")
+        assert solver.params["ip"]["weight"].dtype == jnp.float32

@@ -80,8 +80,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, sk,
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = (out / l_safe[:, None]).astype(o_ref.dtype)
     # logsumexp per row; backward recomputes p = exp(s - lse). m is never
-    # -inf here (fully-masked blocks clamp blk_m to 0).
-    lse_ref[0] = (m + jnp.log(l_safe)).astype(lse_ref.dtype)
+    # -inf here (fully-masked blocks clamp blk_m to 0). Stored (BH, 1, S):
+    # Mosaic requires the last two block dims to be (8,128)-tiled or equal
+    # to the array dims — the singleton axis satisfies that where a 2D
+    # (1, bq) block would not.
+    lse_ref[0, 0] = (m + jnp.log(l_safe)).astype(lse_ref.dtype)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -89,8 +92,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0].astype(jnp.float32)       # (bq,)
-    delta = delta_ref[0].astype(jnp.float32)   # (bq,)
+    lse = lse_ref[0, 0].astype(jnp.float32)       # (bq,)
+    delta = delta_ref[0, 0].astype(jnp.float32)   # (bq,)
     n_k = sk // bk
 
     def body(j, dq):
@@ -130,8 +133,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[0, pl.dslice(i * bq, bq), :].astype(jnp.float32)
         do = do_ref[0, pl.dslice(i * bq, bq), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.dslice(i * bq, bq)].astype(jnp.float32)
-        delta = delta_ref[0, pl.dslice(i * bq, bq)].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.dslice(i * bq, bq)].astype(jnp.float32)
+        delta = delta_ref[0, 0, pl.dslice(i * bq, bq)].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse[:, None])          # (bq, bk)
@@ -187,11 +190,11 @@ def _fwd_impl(q, k, v, causal, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, bq), lambda i, j: (i, j)),
+            pl.BlockSpec((1, 1, bq), lambda i, j: (i, 0, j)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -202,9 +205,10 @@ def _bwd_impl(q, k, v, out, lse, do, causal, interpret):
     sk = k.shape[1]
     bq, bk = _check_tiles(sq, sk)
     scale = 1.0 / math.sqrt(d)
-    # D_i = rowsum(dO * O) — cheap elementwise+reduce; XLA fuses it
+    # D_i = rowsum(dO * O) — cheap elementwise+reduce; XLA fuses it.
+    # (BH, 1, S) layout for the same Mosaic tiling reason as lse.
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)
+                    axis=-1)[:, None, :]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           sk=sk, bq=bq, bk=bk),
@@ -214,8 +218,8 @@ def _bwd_impl(q, k, v, out, lse, do, causal, interpret):
             pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # k
             pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # v
             pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),   # do
-            pl.BlockSpec((1, bq), lambda i, j: (i, j)),         # lse
-            pl.BlockSpec((1, bq), lambda i, j: (i, j)),         # delta
+            pl.BlockSpec((1, 1, bq), lambda i, j: (i, 0, j)),   # lse
+            pl.BlockSpec((1, 1, bq), lambda i, j: (i, 0, j)),   # delta
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
@@ -230,8 +234,8 @@ def _bwd_impl(q, k, v, out, lse, do, causal, interpret):
             pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),   # k
             pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),   # v
             pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),   # do
-            pl.BlockSpec((1, sq), lambda i, j: (i, 0)),         # lse
-            pl.BlockSpec((1, sq), lambda i, j: (i, 0)),         # delta
+            pl.BlockSpec((1, 1, sq), lambda i, j: (i, 0, 0)),   # lse
+            pl.BlockSpec((1, 1, sq), lambda i, j: (i, 0, 0)),   # delta
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),

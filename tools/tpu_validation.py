@@ -72,17 +72,19 @@ assert jax.devices()[0].platform == 'tpu'
 r = np.random.RandomState(0)
 mk = lambda: jnp.asarray(r.randn(2, 256, 2, 32).astype(np.float32))
 q, k, v = mk(), mk(), mk()
+# tolerances are scale-relative: BOTH paths round f32 matmuls through the
+# MXU's bf16 passes (in different tile orders), so agreement is at bf16
+# quantization level (~4e-3 relative), not f32 level like interpret mode
 for causal in (False, True):
-    ref = attention(q, k, v, causal=causal)
-    out = flash_attention(q, k, v, causal=causal, interpret=False)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                               rtol=2e-3, atol=1e-4)
-    g = jax.grad(lambda q: jnp.sum(flash_attention(
-        q, k, v, causal=causal, interpret=False) ** 2))(q)
-    gr = jax.grad(lambda q: jnp.sum(
-        attention(q, k, v, causal=causal) ** 2))(q)
-    np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
-                               rtol=5e-3, atol=1e-3)
+    ref = np.asarray(attention(q, k, v, causal=causal))
+    out = np.asarray(flash_attention(q, k, v, causal=causal,
+                                     interpret=False))
+    assert np.max(np.abs(out - ref)) < 5e-3 * np.max(np.abs(ref)), causal
+    g = np.asarray(jax.grad(lambda q: jnp.sum(flash_attention(
+        q, k, v, causal=causal, interpret=False) ** 2))(q))
+    gr = np.asarray(jax.grad(lambda q: jnp.sum(
+        attention(q, k, v, causal=causal) ** 2))(q))
+    assert np.max(np.abs(g - gr)) < 1e-2 * np.max(np.abs(gr)), causal
     print(f'causal={causal}: fwd+bwd Mosaic kernels match reference')
 """],
             900, log)

@@ -2,6 +2,7 @@
 
 from .base import LAYER_REGISTRY, Layer, ParamDecl, create_layer, register, registered_types
 from . import activations  # noqa: F401
+from . import composite  # noqa: F401
 from . import extension  # noqa: F401
 from . import data_layers  # noqa: F401
 from . import dense  # noqa: F401

@@ -56,6 +56,10 @@ class Layer:
         self.params: dict[str, ParamDecl] = {}
         self.in_shapes: list[Shape] = []
         self.out_shapes: list[Shape] = []
+        # parallel.MeshPlan bound by Net.bind_mesh when the solver runs
+        # SPMD; layers with distributed execution modes (Attention
+        # sequence_parallel, Pipeline stages) read it at trace time
+        self.mesh_plan = None
 
     # -- graph construction ------------------------------------------------
     def setup(self, in_shapes: list[Shape]) -> list[Shape]:

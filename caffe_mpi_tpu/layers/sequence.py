@@ -85,7 +85,7 @@ class AttentionLayer(Layer):
         return [in_shapes[0]]
 
     def apply(self, params, state, bottoms, *, train, rng):
-        from ..ops.attention import attention
+        from ..ops.attention import attention, sequence_parallel_attention
         p = self.p
         x = self.f(bottoms[0])
         n, s, c = x.shape
@@ -94,8 +94,20 @@ class AttentionLayer(Layer):
             qkv = qkv + self.f(params["qkv_bias"])
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (n, s, self.heads, c // self.heads)
-        out = attention(q.reshape(shape), k.reshape(shape), v.reshape(shape),
-                        causal=bool(p.causal), use_flash=bool(p.use_flash))
+        q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
+        mp = self.mesh_plan
+        if (p.sequence_parallel and mp is not None
+                and mp.mesh.shape.get("model", 1) > 1):
+            # prototxt-declared SP: the sequence dim shards over 'model'
+            # and K/V ride the ICI ring (ops/attention.py ring_attention);
+            # the batch dim stays on 'data' so DPxSP composes
+            out = sequence_parallel_attention(
+                q, k, v, mp.mesh, seq_axis="model", causal=bool(p.causal),
+                batch_axis="data" if mp.mesh.shape.get("data", 1) > 1
+                else None)
+        else:
+            out = attention(q, k, v, causal=bool(p.causal),
+                            use_flash=bool(p.use_flash))
         y = out.reshape(n, s, c) @ self.f(params["proj_weight"]).T
         if p.bias_term:
             y = y + self.f(params["proj_bias"])

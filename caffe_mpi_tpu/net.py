@@ -201,6 +201,15 @@ class Net:
                     lp.name, p.batch_size, divisor)
             p.batch_size = max(1, (p.batch_size + divisor - 1) // divisor)
 
+    def bind_mesh(self, mesh_plan) -> None:
+        """Hand every layer the active MeshPlan (reference analogue: the
+        Caffe singleton's solver_count/rank TLS that layers consult;
+        common.hpp:298-544). Layers with distributed execution modes —
+        Attention sequence_parallel, Pipeline — specialize their traced
+        computation on it; all others ignore it."""
+        for layer in self.layers:
+            layer.mesh_plan = mesh_plan
+
     def _layer_by_name(self, name: str) -> Layer:
         # built lazily: callers run both during Init (partial layer list)
         # and after; an O(n) scan inside the build loop made net

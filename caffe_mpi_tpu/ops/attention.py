@@ -127,21 +127,26 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     # types line up under shard_map's per-device type tracking
     from ..parallel.mesh import mark_varying
 
-    m0 = mark_varying(jnp.full((b, h, s), -jnp.inf, q.dtype), axis_name)
-    l0 = mark_varying(jnp.zeros((b, h, s), q.dtype), axis_name)
+    m0 = mark_varying(jnp.full((b, h, s), -jnp.inf, q.dtype), like=q)
+    l0 = mark_varying(jnp.zeros((b, h, s), q.dtype), like=q)
     (out, m, l, _, _), _ = lax.scan(step, (out0, m0, l0, k, v),
                                     jnp.arange(n_dev))
     return out / jnp.maximum(l, 1e-30)[..., None].swapaxes(1, 2)
 
 
 def sequence_parallel_attention(q, k, v, mesh, *, seq_axis: str = "model",
-                                causal: bool = False):
+                                causal: bool = False,
+                                batch_axis: str | None = None):
     """Top-level entry: q,k,v (B,S,H,D) global arrays; shards S over
     `seq_axis` and runs ring attention under shard_map.
 
     Uneven sequence lengths are handled by padding S up to a multiple of
     the ring size and masking the padded key positions in every block;
-    the pad rows are sliced off the output."""
+    the pad rows are sliced off the output.
+
+    batch_axis: optional mesh axis the batch dim is sharded over — pass
+    'data' when running inside a DPxSP training step so the shard_map
+    keeps the data-parallel batch split instead of all-gathering it."""
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
@@ -155,7 +160,7 @@ def sequence_parallel_attention(q, k, v, mesh, *, seq_axis: str = "model",
         k = jnp.pad(k, widths)
         v = jnp.pad(v, widths)
 
-    spec = P(None, seq_axis, None, None)
+    spec = P(batch_axis, seq_axis, None, None)
     fn = shard_map(
         functools.partial(ring_attention, axis_name=seq_axis, causal=causal,
                           valid_len=valid_len),

@@ -39,14 +39,28 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 log = logging.getLogger("caffe_mpi_tpu.parallel")
 
 
-def mark_varying(x, axis_name: str):
-    """Mark a value as varying over a mesh axis (shard_map per-device type
+def mark_varying(x, axis_name: str | None = None, *, like=None):
+    """Mark a value as varying over mesh axes (shard_map per-device type
     tracking). Shim over the in-flux pcast/pvary jax API — the single
-    definition used by ring attention and the pipeline schedule."""
+    definition used by ring attention and the pipeline schedule.
+    Idempotent: axes x already varies over are skipped.
+
+    like: instead of naming an axis, copy the varying-axis set of another
+    value — scan carries built from jnp.zeros/full must match the vma of
+    the sharded inputs they merge with, whatever axes the enclosing
+    shard_map spans (e.g. 'data' x 'model' in a DPxSP step)."""
     from jax import lax
+    if like is not None:
+        axes = tuple(getattr(jax.typeof(like), "vma", ()))
+    else:
+        axes = (axis_name,)
+    cur = frozenset(getattr(jax.typeof(x), "vma", ()))
+    missing = tuple(a for a in axes if a and a not in cur)
+    if not missing:
+        return x
     if hasattr(lax, "pcast"):
-        return lax.pcast(x, (axis_name,), to="varying")
-    return lax.pvary(x, (axis_name,))
+        return lax.pcast(x, missing, to="varying")
+    return lax.pvary(x, missing)
 
 
 def init_distributed(coordinator: str | None = None, num_processes: int | None = None,

@@ -419,6 +419,11 @@ class AttentionParameter(Message):
     num_heads: int = 1
     causal: bool = False
     use_flash: bool = False
+    # route through ring attention with the sequence dim sharded over the
+    # mesh 'model' axis (ops/attention.py sequence_parallel_attention).
+    # Takes effect when the solver runs with a mesh whose model axis > 1;
+    # single-device execution falls back to standard attention.
+    sequence_parallel: bool = False
     bias_term: bool = True
     weight_filler: FillerParameter | None = None
     bias_filler: FillerParameter | None = None
@@ -711,6 +716,22 @@ class FilterParameter(Message):
 # ---------------------------------------------------------------------------
 
 @dataclass
+class PipelineParameter(Message):
+    """TPU-native extension (no reference analogue — SURVEY §2.7: PP
+    absent, ForwardFromTo is a sequential one-device loop): a stack of
+    `num_stages` STRUCTURALLY IDENTICAL blocks, each block being the
+    repeated `layer {...}` sub-graph, executed as a GPipe shift-register
+    over the mesh 'model' axis (parallel/pipeline.py). Under a mesh whose
+    model axis equals num_stages the batch is split into `micro_batches`
+    microbatches and stage s's weights live only on mesh position s; on a
+    single device the same stacked params run as a sequential lax.scan —
+    bit-identical math either way."""
+    num_stages: int = 0
+    micro_batches: int = 1
+    layer: list[LayerParameter] = _rep()
+
+
+@dataclass
 class LayerParameter(Message):
     """One op instance in the graph (caffe.proto LayerParameter:368-480)."""
     name: str = ""
@@ -778,6 +799,7 @@ class LayerParameter(Message):
     lrn_param: LRNParameter | None = None
     memory_data_param: MemoryDataParameter | None = None
     mvn_param: MVNParameter | None = None
+    pipeline_param: PipelineParameter | None = None
     pooling_param: PoolingParameter | None = None
     power_param: PowerParameter | None = None
     prelu_param: PReLUParameter | None = None

@@ -127,6 +127,9 @@ class Solver:
             # replicated by default, or tensor-parallel-sharded per rules
             self.net_state = mesh.replicate(self.net_state)
             self._place_params_opt()
+            self.net.bind_mesh(mesh)
+            for tnet in self.test_nets:
+                tnet.bind_mesh(mesh)
         self.iter = 0
         self._loss_window = deque(maxlen=max(sp.average_loss, 1))
         self._step_jit = None
@@ -148,6 +151,14 @@ class Solver:
         partial-sum all-reduce)."""
         rules = {}
         for layer in self.net.layers:
+            if (layer.lp.type == "Pipeline"
+                    and layer.n_stages == self.mesh.mesh.shape.get("model", 1)
+                    and layer.n_stages > 1):
+                # stacked stage params shard their leading (stage) dim over
+                # 'model' automatically: one stage per device is the whole
+                # point of PP (parallel/pipeline.py)
+                rules[layer.name] = {pn: ("model",) for pn in layer.params}
+                continue
             s = getattr(layer.lp, "param_sharding", "")
             if not s:
                 continue

@@ -854,6 +854,57 @@ def transformer_lm(batch=8, seq=64, vocab=256, dim=128, heads=4,
     return n
 
 
+def transformer_lm_pp_prototxt(batch=8, seq=64, vocab=256, dim=128, heads=4,
+                               n_stages=4, micro_batches=4, ffn_hidden=256):
+    """Pipeline-parallel transformer_lm variant: the trunk is ONE Pipeline
+    layer whose repeated block is the pre-LN attention+FFN pair, so
+    `caffe train -solver models/transformer_lm/solver_pp.prototxt -mesh
+    data=N,model=4` trains with stage weights sharded one-per-device
+    (layers/composite.py). Stages must be structurally identical, so this
+    variant is homogeneous (no MoE block) and emitted as text rather than
+    through NetSpec (which has no nested-block syntax)."""
+    blk = f"""    layer {{ name: "ln1" type: "LayerNorm" bottom: "h" top: "n1" }}
+    layer {{ name: "attn" type: "Attention" bottom: "n1" top: "a"
+             attention_param {{ num_heads: {heads} causal: true
+               weight_filler {{ type: "gaussian" std: 0.02 }} }} }}
+    layer {{ name: "res1" type: "Eltwise" bottom: "h" bottom: "a" top: "r1" }}
+    layer {{ name: "ln2" type: "LayerNorm" bottom: "r1" top: "n2" }}
+    layer {{ name: "fc1" type: "InnerProduct" bottom: "n2" top: "f1"
+             inner_product_param {{ num_output: {ffn_hidden} axis: 2
+               weight_filler {{ type: "gaussian" std: 0.02 }} }} }}
+    layer {{ name: "relu" type: "ReLU" bottom: "f1" top: "f1" }}
+    layer {{ name: "fc2" type: "InnerProduct" bottom: "f1" top: "f2"
+             inner_product_param {{ num_output: {dim} axis: 2
+               weight_filler {{ type: "gaussian" std: 0.02 }} }} }}
+    layer {{ name: "res2" type: "Eltwise" bottom: "r1" bottom: "f2"
+             top: "out" }}"""
+    return f"""name: "transformer_lm_pp"
+layer {{ name: "tokens" type: "Input" top: "tokens" top: "label"
+        input_param {{ shape {{ dim: {batch} dim: {seq} }}
+                       shape {{ dim: {batch} dim: {seq} }} }} }}
+layer {{ name: "embed" type: "Embed" bottom: "tokens" top: "embed"
+        embed_param {{ input_dim: {vocab} num_output: {dim} bias_term: false
+          weight_filler {{ type: "gaussian" std: 0.02 }} }} }}
+layer {{ name: "pos" type: "Parameter" top: "pos"
+        parameter_param {{ shape {{ dim: {seq} dim: {dim} }} }} }}
+layer {{ name: "h" type: "Bias" bottom: "embed" bottom: "pos" top: "h"
+        bias_param {{ axis: 1 }} }}
+layer {{ name: "trunk" type: "Pipeline" bottom: "h" top: "hN"
+        pipeline_param {{ num_stages: {n_stages}
+          micro_batches: {micro_batches}
+{blk} }} }}
+layer {{ name: "ln_f" type: "LayerNorm" bottom: "hN" top: "ln_f" }}
+layer {{ name: "logits" type: "InnerProduct" bottom: "ln_f" top: "logits"
+        inner_product_param {{ num_output: {vocab} axis: 2
+          weight_filler {{ type: "gaussian" std: 0.02 }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "logits"
+        bottom: "label" top: "loss" softmax_param {{ axis: 2 }} }}
+layer {{ name: "accuracy" type: "Accuracy" bottom: "logits" bottom: "label"
+        top: "accuracy" accuracy_param {{ axis: 2 }}
+        include {{ phase: TEST }} }}
+"""
+
+
 SOLVERS = {
     "transformer_lm": """# transformer_lm solver (beyond-reference demo model; Adam recipe)
 net: "models/transformer_lm/train_val.prototxt"
@@ -1164,6 +1215,27 @@ def main():
         with open(os.path.join(d, "deploy.prototxt"), "w") as f:
             f.write(make_deploy(tv) + "\n")
         print(f"wrote models/{name}/")
+
+    # transformer_lm model-parallel variants: PP trunk (Pipeline layer)
+    # and SP attention (sequence_parallel: true), each launchable from one
+    # `caffe train -mesh data=N,model=M` line
+    d = os.path.join(out_root, "transformer_lm")
+    with open(os.path.join(d, "train_val_pp.prototxt"), "w") as f:
+        f.write(transformer_lm_pp_prototxt())
+    base = open(os.path.join(d, "train_val.prototxt")).read()
+    with open(os.path.join(d, "train_val_sp.prototxt"), "w") as f:
+        f.write(base.replace("causal: true",
+                             "causal: true\n    sequence_parallel: true"))
+    solver = open(os.path.join(d, "solver.prototxt")).read()
+    for variant in ("pp", "sp"):
+        with open(os.path.join(d, f"solver_{variant}.prototxt"), "w") as f:
+            # the second replace also renames the snapshot_prefix line
+            # (it ends in transformer_lm")
+            f.write(solver.replace("train_val.prototxt",
+                                   f"train_val_{variant}.prototxt")
+                    .replace("transformer_lm\"",
+                             f"transformer_lm_{variant}\""))
+    print("wrote models/transformer_lm/ pp + sp variants")
 
 
 if __name__ == "__main__":

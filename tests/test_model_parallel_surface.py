@@ -1,0 +1,208 @@
+"""Prototxt-level SP and PP surface tests (8-device CPU mesh).
+
+Beyond-reference capabilities (SURVEY §2.7: the reference is DP-only)
+made reachable from the model definition: `attention_param {
+sequence_parallel: true }` routes to ring attention over the mesh 'model'
+axis, and the `Pipeline` layer type runs its repeated block as a GPipe
+shift-register over the same axis. The invariant mirrors
+test_parallel.py: the distributed execution must produce the SAME
+parameter trajectory as plain single-device training.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from caffe_mpi_tpu.parallel import MeshPlan
+from caffe_mpi_tpu.proto import NetParameter, SolverParameter
+from caffe_mpi_tpu.solver import Solver
+
+SP_NET = """
+name: "sp_attn"
+layer { name: "in" type: "Input" top: "x" top: "tgt"
+        input_param { shape { dim: 8 dim: 10 dim: 16 }
+                      shape { dim: 8 dim: 10 dim: 16 } } }
+layer { name: "attn" type: "Attention" bottom: "x" top: "a"
+        attention_param { num_heads: 4 causal: true sequence_parallel: true
+                          weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "loss" type: "EuclideanLoss" bottom: "a" bottom: "tgt" top: "l" }
+"""
+
+# block input blob name == the Pipeline layer's bottom ("h")
+PP_NET = """
+name: "pp_mlp"
+layer { name: "in" type: "Input" top: "h" top: "tgt"
+        input_param { shape { dim: 8 dim: 16 } shape { dim: 8 dim: 16 } } }
+layer { name: "trunk" type: "Pipeline" bottom: "h" top: "y"
+        pipeline_param { num_stages: 4 micro_batches: 4
+          layer { name: "fc" type: "InnerProduct" bottom: "h" top: "fh"
+                  inner_product_param { num_output: 16
+                    weight_filler { type: "xavier" } } }
+          layer { name: "act" type: "TanH" bottom: "fh" top: "fy" } } }
+layer { name: "loss" type: "EuclideanLoss" bottom: "y" bottom: "tgt" top: "l" }
+"""
+
+TRANSFORMER_PP_NET = """
+name: "tiny_lm_pp"
+layer { name: "tok" type: "Input" top: "tokens" top: "label"
+        input_param { shape { dim: 4 dim: 12 } shape { dim: 4 dim: 12 } } }
+layer { name: "embed" type: "Embed" bottom: "tokens" top: "h"
+        embed_param { input_dim: 32 num_output: 24 bias_term: false
+                      weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "trunk" type: "Pipeline" bottom: "h" top: "hN"
+        pipeline_param { num_stages: 4 micro_batches: 2
+          layer { name: "ln1" type: "LayerNorm" bottom: "h" top: "n1" }
+          layer { name: "attn" type: "Attention" bottom: "n1" top: "a"
+                  attention_param { num_heads: 2 causal: true
+                    weight_filler { type: "gaussian" std: 0.1 } } }
+          layer { name: "res1" type: "Eltwise" bottom: "h" bottom: "a"
+                  top: "r1" }
+          layer { name: "ln2" type: "LayerNorm" bottom: "r1" top: "n2" }
+          layer { name: "fc1" type: "InnerProduct" bottom: "n2" top: "f1"
+                  inner_product_param { num_output: 48 axis: 2
+                    weight_filler { type: "gaussian" std: 0.1 } } }
+          layer { name: "relu" type: "ReLU" bottom: "f1" top: "f1" }
+          layer { name: "fc2" type: "InnerProduct" bottom: "f1" top: "f2"
+                  inner_product_param { num_output: 24 axis: 2
+                    weight_filler { type: "gaussian" std: 0.1 } } }
+          layer { name: "res2" type: "Eltwise" bottom: "r1" bottom: "f2"
+                  top: "out" } } }
+layer { name: "lnf" type: "LayerNorm" bottom: "hN" top: "hf" }
+layer { name: "logits" type: "InnerProduct" bottom: "hf" top: "logits"
+        inner_product_param { num_output: 32 axis: 2
+          weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "logits" bottom: "label"
+        top: "loss" softmax_param { axis: 2 } }
+"""
+
+
+def make_solver(net_text, mesh=None, lr=0.05):
+    sp = SolverParameter.from_text(
+        f'base_lr: {lr} momentum: 0.9 lr_policy: "fixed" max_iter: 50 '
+        'type: "SGD" random_seed: 7')
+    sp.net_param = NetParameter.from_text(net_text)
+    return Solver(sp, mesh=mesh)
+
+
+def sp_batches(n, seed=3):
+    r = np.random.RandomState(seed)
+    return [{"x": jnp.asarray(r.randn(8, 10, 16).astype(np.float32)),
+             "tgt": jnp.asarray(r.randn(8, 10, 16).astype(np.float32))}
+            for _ in range(n)]
+
+
+def pp_batches(n, seed=4):
+    r = np.random.RandomState(seed)
+    return [{"h": jnp.asarray(r.randn(8, 16).astype(np.float32)),
+             "tgt": jnp.asarray(r.randn(8, 16).astype(np.float32))}
+            for _ in range(n)]
+
+
+def lm_batches(n, seed=5):
+    r = np.random.RandomState(seed)
+    return [{"tokens": jnp.asarray(r.randint(0, 32, (4, 12))),
+             "label": jnp.asarray(r.randint(0, 32, (4, 12)))}
+            for _ in range(n)]
+
+
+class TestSequenceParallelSurface:
+    def test_prototxt_flag_parses(self):
+        net = NetParameter.from_text(SP_NET)
+        assert net.layer[1].attention_param.sequence_parallel is True
+
+    def test_sp_matches_single_device(self):
+        """DPxSP (2x4 mesh; seq 10 pads to 12 over the 4-way ring) trains
+        to the same parameters as plain single-device attention."""
+        data = sp_batches(8)
+        s_one = make_solver(SP_NET)
+        s_sp = make_solver(SP_NET, mesh=MeshPlan.from_shape(data=2, model=4))
+        l1 = s_one.step(5, lambda it: data[it])
+        l2 = s_sp.step(5, lambda it: data[it])
+        assert l1 == pytest.approx(l2, rel=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(s_one.params["attn"]["qkv_weight"]),
+            np.asarray(s_sp.params["attn"]["qkv_weight"]),
+            rtol=2e-4, atol=1e-6)
+
+    def test_flag_without_mesh_is_standard_attention(self):
+        s = make_solver(SP_NET)  # no mesh: falls back, must still train
+        data = sp_batches(2)
+        s.step(2, lambda it: data[it % 2])
+
+
+class TestPipelineSurface:
+    def test_prototxt_parses_and_roundtrips(self):
+        net = NetParameter.from_text(PP_NET)
+        pp = net.layer[1].pipeline_param
+        assert pp.num_stages == 4 and pp.micro_batches == 4
+        assert [l.type for l in pp.layer] == ["InnerProduct", "TanH"]
+        # text round-trip preserves the nested block
+        net2 = NetParameter.from_text(net.to_prototxt())
+        assert len(net2.layer[1].pipeline_param.layer) == 2
+
+    def test_stacked_params_and_sequential_semantics(self):
+        """Single device: the Pipeline layer is a scan over num_stages
+        independent copies of the block — verify against a hand loop."""
+        from caffe_mpi_tpu.net import Net
+        net = Net(NetParameter.from_text(PP_NET))
+        params, state = net.init(jax.random.PRNGKey(0))
+        w = params["trunk"]["fc.weight"]
+        assert w.shape == (4, 16, 16)
+        # stages are independently initialized, not copies
+        assert float(jnp.abs(w[0] - w[1]).max()) > 1e-3
+        r = np.random.RandomState(0)
+        feeds = {"h": jnp.asarray(r.randn(8, 16).astype(np.float32)),
+                 "tgt": jnp.zeros((8, 16), jnp.float32)}
+        blobs, _, _ = net.apply(params, state, feeds, train=False)
+        x = feeds["h"]
+        for s in range(4):
+            x = jnp.tanh(x @ w[s].T + params["trunk"]["fc.bias"][s])
+        np.testing.assert_allclose(np.asarray(blobs["y"]), np.asarray(x),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_pp_matches_single_device(self):
+        """DPxPP (2x4 mesh): stage weights sharded one-per-device, batch
+        split into microbatches — same trajectory as sequential."""
+        data = pp_batches(8)
+        s_one = make_solver(PP_NET)
+        s_pp = make_solver(PP_NET, mesh=MeshPlan.from_shape(data=2, model=4))
+        # stage dim sharded over 'model': the PP memory story
+        w = s_pp.params["trunk"]["fc.weight"]
+        assert not w.sharding.is_fully_replicated
+        l1 = s_one.step(5, lambda it: data[it])
+        l2 = s_pp.step(5, lambda it: data[it])
+        assert l1 == pytest.approx(l2, rel=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(s_one.params["trunk"]["fc.weight"]),
+            np.asarray(s_pp.params["trunk"]["fc.weight"]),
+            rtol=2e-4, atol=1e-6)
+
+    def test_transformer_lm_pp_matches_single_device(self):
+        """The VERDICT bar: a transformer LM trains with PP from a
+        prototxt, exact-match vs sequential. 4-stage trunk of
+        LN->Attention->residual->LN->FFN->residual blocks."""
+        data = lm_batches(6)
+        s_one = make_solver(TRANSFORMER_PP_NET, lr=0.1)
+        s_pp = make_solver(TRANSFORMER_PP_NET, lr=0.1,
+                           mesh=MeshPlan.from_shape(data=2, model=4))
+        l1 = s_one.step(3, lambda it: data[it])
+        l2 = s_pp.step(3, lambda it: data[it])
+        assert l1 == pytest.approx(l2, rel=1e-4)
+        for pname in ("attn.qkv_weight", "fc1.weight", "ln1.scale"):
+            np.testing.assert_allclose(
+                np.asarray(s_one.params["trunk"][pname]),
+                np.asarray(s_pp.params["trunk"][pname]),
+                rtol=5e-4, atol=1e-6, err_msg=pname)
+
+    def test_shape_preserving_enforced(self):
+        bad = PP_NET.replace("num_output: 16\n", "num_output: 12\n", 1)
+        with pytest.raises(ValueError, match="shape-preserving"):
+            make_solver(bad)
+
+    def test_stateful_block_rejected(self):
+        bad = PP_NET.replace(
+            'layer { name: "act" type: "TanH" bottom: "fh" top: "fy" }',
+            'layer { name: "act" type: "BatchNorm" bottom: "fh" top: "fy" }')
+        with pytest.raises(ValueError, match="stateful"):
+            make_solver(bad)

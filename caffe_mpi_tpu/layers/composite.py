@@ -39,9 +39,13 @@ stream is not yet threaded.
 
 from __future__ import annotations
 
+import logging
+
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+log = logging.getLogger(__name__)
 
 from ..core.fillers import fill
 from .base import Layer, ParamDecl, Shape, create_layer, register
@@ -71,11 +75,28 @@ class PipelineLayer(Layer):
         self.block: list[Layer] = []
         self.block_input = self.lp.bottom[0]
         env = {self.block_input: in_shape}
+        if self.n_micro % self.n_stages:
+            # pipeline_apply pads the microbatch count up to a multiple of
+            # num_stages and discards the pad results — legal, but the pad
+            # microbatches cost full stage compute
+            log.warning(
+                "layer %s: micro_batches %d is not a multiple of num_stages "
+                "%d; the pipelined schedule pads to %d and %d of them are "
+                "wasted compute", self.name, self.n_micro, self.n_stages,
+                -(-self.n_micro // self.n_stages) * self.n_stages,
+                (-self.n_micro) % self.n_stages)
         for ilp in p.layer:
             if ilp.type == "Dropout" and self.phase == "TRAIN":
                 raise ValueError(
                     f"layer {self.name!r}: Dropout inside a Pipeline block "
                     "is unsupported in TRAIN phase (no per-stage rng stream)")
+            if (ilp.attention_param is not None
+                    and ilp.attention_param.sequence_parallel):
+                raise ValueError(
+                    f"pipeline block layer {ilp.name!r}: sequence_parallel "
+                    "attention inside a Pipeline block is unsupported — the "
+                    "stage is already shard_mapped over the 'model' axis, so "
+                    "the sequence cannot shard over it too")
             il = create_layer(ilp, self.policy, self.phase)
             shapes = []
             for b in ilp.bottom:

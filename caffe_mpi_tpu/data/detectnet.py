@@ -28,19 +28,30 @@ from ..proto.config import (
 )
 
 
+# RGB <-> YIQ bases, fixed. The hue-rotation matrix is linear in
+# (cos t, sin t): m(t) = _HUE_A + cos(t) * _HUE_B + sin(t) * _HUE_C, with
+# all three terms composed ONCE at import time. _hue_rotate may run on an
+# XLA host-callback thread (the DetectNetTransformation layer executes
+# through jax.pure_callback), where ANY OpenBLAS entry (linalg.inv, 2-D
+# `@`) can deadlock against the single-core XLA thread pool — the
+# per-call math below is scalar/ufunc arithmetic only.
+_T_YIQ = np.array([[0.299, 0.587, 0.114],
+                   [0.596, -0.274, -0.322],
+                   [0.211, -0.523, 0.312]])
+_T_YIQ_INV = np.linalg.inv(_T_YIQ)
+_HUE_A = _T_YIQ_INV @ np.diag([1.0, 0.0, 0.0]) @ _T_YIQ
+_HUE_B = _T_YIQ_INV @ np.diag([0.0, 1.0, 1.0]) @ _T_YIQ
+_HUE_C = _T_YIQ_INV @ np.array([[0, 0, 0], [0, 0, -1.0], [0, 1.0, 0]]) @ _T_YIQ
+
+
 def _hue_rotate(img: np.ndarray, degrees: float) -> np.ndarray:
     """Rotate hue via a YIQ-space rotation (cheap approximation of the
     reference's HSV hue shift; BGR CHW float input)."""
     theta = np.deg2rad(degrees)
-    u, w = np.cos(theta), np.sin(theta)
-    # BGR -> YIQ rotation -> BGR, composed into one 3x3
-    t_yiq = np.array([[0.299, 0.587, 0.114],
-                      [0.596, -0.274, -0.322],
-                      [0.211, -0.523, 0.312]])
-    rot = np.array([[1, 0, 0], [0, u, -w], [0, w, u]])
-    m = np.linalg.inv(t_yiq) @ rot @ t_yiq  # operates on RGB
+    m = _HUE_A + np.cos(theta) * _HUE_B + np.sin(theta) * _HUE_C
     rgb = img[::-1]  # BGR -> RGB
-    out = np.einsum("ij,jhw->ihw", m, rgb)
+    out = np.stack([m[i, 0] * rgb[0] + m[i, 1] * rgb[1] + m[i, 2] * rgb[2]
+                    for i in range(3)])
     return np.clip(out[::-1], 0, 255)
 
 
@@ -60,12 +71,28 @@ class DetectNetAugmenter:
         self.phase = phase
 
     def __call__(self, img: np.ndarray, bboxes: np.ndarray,
-                 rng: np.random.Generator) -> tuple[np.ndarray, np.ndarray]:
+                 rng: np.random.Generator, mean: np.ndarray | None = None
+                 ) -> tuple[np.ndarray, np.ndarray]:
+        """mean: optional per-channel (C,) mean subtracted AFTER the
+        photometric augmentations but BEFORE the geometric ones — the
+        reference's order (transform_image_cpu: HSV transforms, then
+        meanSubtract, then flip/scale/crop), which makes the crop's
+        zero-pad equal the mean in pixel space."""
         a = self.aug
         out_w, out_h = self.gt.image_size_x, self.gt.image_size_y
         img = np.asarray(img, np.float32)
         bboxes = np.asarray(bboxes, np.float32).reshape(-1, 5).copy()
         train = self.phase == "TRAIN"
+
+        # photometric first, in [0,255] pixel space (reference does HSV
+        # before mean subtraction)
+        if train and a.hue_rotation_prob > 0 and rng.random() < a.hue_rotation_prob:
+            img = _hue_rotate(img, float(rng.uniform(-a.hue_rotation,
+                                                     a.hue_rotation)))
+        if train and a.desaturation_prob > 0 and rng.random() < a.desaturation_prob:
+            img = _desaturate(img, float(rng.random() * a.desaturation_max))
+        if mean is not None:
+            img = img - np.asarray(mean, np.float32)[:, None, None]
 
         if train and a.scale_prob > 0 and rng.random() < a.scale_prob:
             s = a.scale_min + rng.random() * (a.scale_max - a.scale_min)
@@ -104,12 +131,6 @@ class DetectNetAugmenter:
             x1 = out_w - 1 - bboxes[:, 3]
             x2 = out_w - 1 - bboxes[:, 1]
             bboxes[:, 1], bboxes[:, 3] = x1, x2
-
-        if train and a.hue_rotation_prob > 0 and rng.random() < a.hue_rotation_prob:
-            img = _hue_rotate(img, float(rng.uniform(-a.hue_rotation,
-                                                     a.hue_rotation)))
-        if train and a.desaturation_prob > 0 and rng.random() < a.desaturation_prob:
-            img = _desaturate(img, float(rng.random() * a.desaturation_max))
 
         # drop bboxes that left the canvas entirely
         keep = (bboxes[:, 3] > 0) & (bboxes[:, 4] > 0) & \

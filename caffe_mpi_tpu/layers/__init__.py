@@ -6,6 +6,7 @@ from . import composite  # noqa: F401
 from . import extension  # noqa: F401
 from . import data_layers  # noqa: F401
 from . import dense  # noqa: F401
+from . import detection  # noqa: F401
 from . import losses  # noqa: F401
 from . import norm  # noqa: F401
 from . import sequence  # noqa: F401

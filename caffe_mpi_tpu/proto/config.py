@@ -558,6 +558,14 @@ class RecurrentParameter(Message):
 
 
 @dataclass
+class ClassMapping(Message):
+    """object_class entry: dataset class id `src` -> coverage index `dst`
+    (reference caffe.proto ClassMapping)."""
+    src: int = 0
+    dst: int = 0
+
+
+@dataclass
 class DetectNetGroundTruthParameter(Message):
     """Coverage-grid generation config (reference caffe.proto:511-549)."""
     stride: int = 4
@@ -570,6 +578,7 @@ class DetectNetGroundTruthParameter(Message):
     image_size_y: int = 384
     obj_norm: bool = False
     crop_bboxes: bool = True
+    object_class: list[ClassMapping] = _rep()
 
 
 @dataclass

@@ -131,6 +131,18 @@ class Solver:
             for tnet in self.test_nets:
                 tnet.bind_mesh(mesh)
         self.iter = 0
+        # nets with host-callback layers (DetectNetTransformation) re-enter
+        # Python from inside the compiled step; on the CPU backend (whose
+        # execution slots are scarce) the driver must wait for each such
+        # program before dispatching more work, or the executor deadlocks
+        # against the GIL (see layers/detection.py). On TPU the callback
+        # runs host-side while the chip computes — no sync, keeping the
+        # async pipeline the remote tunnel depends on.
+        def _has_cb(net):
+            return any(getattr(l, "host_callback", False) for l in net.layers)
+        on_cpu = jax.default_backend() == "cpu"
+        self._sync_steps = on_cpu and _has_cb(self.net)
+        self._sync_test = on_cpu and any(map(_has_cb, self.test_nets))
         self._loss_window = deque(maxlen=max(sp.average_loss, 1))
         self._step_jit = None
         self._test_fwd_jits: dict[int, Callable] = {}
@@ -320,6 +332,8 @@ class Solver:
             (self.params, self.net_state, self.opt_state, loss,
              rate) = self._step_jit(self.params, self.net_state,
                                     self.opt_state, feeds_stack, it, rng)
+            if self._sync_steps:
+                jax.block_until_ready(loss)
             # keep the loss ON DEVICE: a float() here would force a host
             # sync every iteration (the reference pays microseconds over
             # PCIe; over a remote TPU link it would serialize the pipeline).
@@ -393,6 +407,8 @@ class Solver:
             for k in range(iters):
                 sums = fwd(self._shared_params(tnet), self.net_state,
                            feed_fn(k))
+                if self._sync_test:
+                    jax.block_until_ready(sums)
                 acc = sums if acc is None else acc + sums
             vals = np.asarray(acc) / iters  # the single host sync
             scores = {b: float(v) for b, v in zip(out_blobs, vals)}

@@ -99,9 +99,14 @@ class DetectNetAugmenter:
             c, h, w = img.shape
             nh, nw = max(int(h * s), 1), max(int(w * s), 1)
             from PIL import Image
-            pil = Image.fromarray(img.transpose(1, 2, 0).astype(np.uint8))
-            img = np.asarray(pil.resize((nw, nh), Image.BILINEAR),
-                             np.float32).transpose(2, 0, 1)
+            # resize in FLOAT (mode 'F', per channel): the image may be
+            # mean-subtracted (negative) here — a uint8 round-trip would
+            # wrap negatives modulo 256 (the reference resizes the float
+            # cv::Mat, transform_image_cpu)
+            img = np.stack([
+                np.asarray(Image.fromarray(ch, mode="F").resize(
+                    (nw, nh), Image.BILINEAR), np.float32)
+                for ch in img])
             bboxes[:, 1:] *= s
 
         c, h, w = img.shape

@@ -48,7 +48,15 @@ def parse_label_blob(rec: np.ndarray) -> np.ndarray:
     corners conversion the coverage generator performs (bbox.br())."""
     flat = np.asarray(rec, np.float32).reshape(-1)
     n = int(flat[0])
-    blen = int(flat[1]) or BBOX_LEN
+    blen = int(flat[1])
+    if blen == 0:
+        blen = BBOX_LEN  # header row of an empty record may be all-zero
+    elif blen != BBOX_LEN:
+        # reference: CHECK_EQ(bboxLen, sizeof(BboxLabel)/sizeof(Dtype)),
+        # detectnet_transform_layer.cpp:212 — misaligned rows would
+        # silently scramble classes/coordinates
+        raise ValueError(f"label record declares bboxLen {blen}, "
+                         f"expected {BBOX_LEN}")
     rows = flat[blen: blen + n * blen].reshape(n, blen)
     out = np.zeros((n, 5), np.float32)
     out[:, 0] = rows[:, 5]                    # classNumber
@@ -103,6 +111,11 @@ class DetectNetTransformationLayer(Layer):
             raise ValueError(
                 f"layer {self.name!r}: data batch {n} != label batch "
                 f"{in_shapes[1][0]} (detectnet_transform_layer.cpp:116)")
+        if in_shapes[0][1] != 3:
+            raise ValueError(
+                f"layer {self.name!r}: expects 3-channel images, got "
+                f"{in_shapes[0][1]} (detectnet_transform_layer.cpp:115 "
+                "CHECK_EQ(channels, 3))")
         tp = self.lp.transform_param
         self.mean_values = list(tp.mean_value) if tp else []
         channels = in_shapes[0][1]

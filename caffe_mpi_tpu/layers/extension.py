@@ -25,6 +25,10 @@ from .base import Layer, Shape, register
 
 @register("Python")
 class PythonLayer(Layer):
+    # forward/backward re-enter Python via jax.pure_callback: the Solver
+    # must serialize steps on the CPU backend (see layers/detection.py)
+    host_callback = True
+
     def setup(self, in_shapes: list[Shape]) -> list[Shape]:
         p = self.lp.python_param
         if p is None or not p.module or not p.layer:

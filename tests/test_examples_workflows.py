@@ -1,0 +1,44 @@
+"""Workflow examples stay green: finetune (+-weights transfer, faster
+convergence) and the extract_features verification it performs.
+
+Mirrors the reference's examples/finetune_flickr_style workflow +
+tools/extract_features.cpp (SURVEY §2.8); the example itself asserts
+(a) the finetuned run beats from-scratch and (b) the HDF5 feature dump
+matches a direct forward — this test just drives it at reduced scale.
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.mark.slow
+def test_finetune_example_end_to_end(monkeypatch):
+    monkeypatch.chdir(_ROOT)
+    spec = importlib.util.spec_from_file_location(
+        "finetune_run", os.path.join(_ROOT, "examples/finetune/run.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main(["-pretrain_iter", "80", "-finetune_iter", "30"]) == 0
+
+
+@pytest.mark.slow
+def test_hdf5_classification_example(monkeypatch):
+    monkeypatch.chdir(_ROOT)
+    spec = importlib.util.spec_from_file_location(
+        "hdf5_run", os.path.join(_ROOT, "examples/hdf5_classification/run.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main(["-max_iter", "600"]) == 0
+
+
+def test_net_surgery_example(monkeypatch):
+    monkeypatch.chdir(_ROOT)
+    spec = importlib.util.spec_from_file_location(
+        "surgery_run", os.path.join(_ROOT, "examples/net_surgery/run.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main([]) == 0

@@ -19,7 +19,12 @@ data_reader.hpp:55-101).
 
 from __future__ import annotations
 
+import logging
+import math
+import os
 import threading
+import time
+from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
 
 import numpy as np
@@ -27,14 +32,31 @@ import numpy as np
 from .datasets import Dataset
 from .transformer import DataTransformer
 
+log = logging.getLogger("caffe_mpi_tpu.feeder")
+
+_LOOKAHEAD_HARD_CAP = 16  # queue-depth ceiling even with RAM to spare
+
+
+def _default_mem_budget() -> int:
+    """Host-RAM budget for in-flight batches: 25% of physical memory,
+    capped at 2 GiB (the reference sizes its queue from free *GPU*
+    memory, data_layer.cpp:66-77; here batches live in host RAM until
+    device_put)."""
+    try:
+        phys = os.sysconf("SC_PHYS_PAGES") * os.sysconf("SC_PAGE_SIZE")
+    except (ValueError, OSError, AttributeError):
+        phys = 8 << 30
+    return min(phys // 4, 2 << 30)
+
 
 class Feeder:
     def __init__(self, dataset: Dataset, transformer: DataTransformer | None,
                  batch_size: int, *, rank: int = 0, world: int = 1,
-                 shuffle: bool = False, seed: int = 0, threads: int = 2,
+                 shuffle: bool = False, seed: int = 0, threads: int = 0,
                  lookahead: int = 3, to_device=None,
                  top_names: tuple[str, str] = ("data", "label"),
-                 device_transform: bool = False):
+                 device_transform: bool = False,
+                 mem_budget: int | None = None):
         """to_device: optional callable(feeds_dict) -> feeds_dict placing
         arrays (e.g. MeshPlan.shard_feeds); applied on the consumer side.
         top_names: blob names for the (image, label) tops — from the data
@@ -42,7 +64,14 @@ class Feeder:
         device_transform: stage raw uint8 batches + per-record aug
         decisions instead of transforming on the host — must match the
         consuming Net's DataLayer.dev_transform (the CLI binds both from
-        the net; see layers/data_layers.py)."""
+        the net; see layers/data_layers.py).
+        threads=0 (the prototxt default) enables AUTO mode, mirroring the
+        reference's iteration-0 prefetch auto-tuning
+        (data_layer.cpp:46-113): worker count defaults to the host core
+        count and the lookahead window is re-sized at runtime from the
+        measured batch-build time vs the consumer's step time, bounded by
+        `mem_budget` bytes of in-flight batches. An explicit threads>0
+        pins both knobs (reference: explicit threads+parser_threads)."""
         self.top_names = top_names
         self.ds = dataset
         self.tf = transformer
@@ -53,8 +82,21 @@ class Feeder:
         self.seed = seed
         self.lookahead = max(lookahead, 1)
         self.to_device = to_device
+        self.auto = threads == 0
+        if self.auto:
+            threads = min(os.cpu_count() or 2, 8)
         self.threads = max(threads, 1)
         self.device_transform = device_transform
+        self.mem_budget = (_default_mem_budget() if mem_budget is None
+                           else mem_budget)
+        # auto-tune telemetry: build durations (producer side), consumer
+        # gaps (time spent OUTSIDE __call__ = the training step), and the
+        # realized batch footprint
+        self._build_times: deque[float] = deque(maxlen=32)
+        self._gaps: deque[float] = deque(maxlen=32)
+        self._last_exit: float | None = None
+        self._calls = 0
+        self._batch_bytes = 0
         # native C++ transform path: engaged when built and the transform is
         # expressible there (no force_color/gray); per-batch uniform-shape
         # uint8 checked at run time, python path as fallback
@@ -91,6 +133,20 @@ class Feeder:
         return int(perm[within])
 
     def _build_batch(self, it: int) -> dict[str, np.ndarray]:
+        t0 = time.perf_counter()
+        out = self._build_batch_inner(it)
+        if self.auto:
+            # pool worker threads append concurrently with the consumer's
+            # retune scan — both sides take the lock
+            with self._lock:
+                self._build_times.append(time.perf_counter() - t0)
+                if not self._batch_bytes:
+                    self._batch_bytes = sum(
+                        v.nbytes for v in out.values()
+                        if isinstance(v, np.ndarray)) or 1
+        return out
+
+    def _build_batch_inner(self, it: int) -> dict[str, np.ndarray]:
         raws, labels, flats = [], [], []
         for slot in range(self.batch):
             rec = self._record_index(it, slot)
@@ -143,9 +199,47 @@ class Feeder:
                          for r, f in zip(raws, flats)])
 
     # ------------------------------------------------------------------
+    def _maybe_retune(self) -> None:
+        """Reference data_layer.cpp:46-113 sizes parser/transformer thread
+        counts once, at iteration 0, from free GPU memory and net cost.
+        Here the analogue is the lookahead window (= number of batches
+        built concurrently by the pool): need supply rate >= demand rate,
+        i.e. lookahead >= build_time / step_time, re-measured at runtime
+        and clamped by the host-RAM budget for in-flight batches."""
+        with self._lock:
+            builds = list(self._build_times)
+            bytes_ = self._batch_bytes
+        if len(builds) < 5 or len(self._gaps) < 5:
+            return
+        build = sorted(builds)[len(builds) // 2]
+        gap = sorted(self._gaps)[len(self._gaps) // 2]
+        want = math.ceil(build / max(gap, 1e-4)) + 1
+        cap = _LOOKAHEAD_HARD_CAP
+        if bytes_:
+            cap = min(cap, max(int(self.mem_budget // bytes_) - 1, 1))
+        want = min(max(want, 1), cap)
+        if want != self.lookahead:
+            log.info("prefetch auto-tune: lookahead %d -> %d "
+                     "(build %.1f ms vs step %.1f ms, batch %.1f MiB, "
+                     "budget %.0f MiB)", self.lookahead, want, build * 1e3,
+                     gap * 1e3, bytes_ / 2**20,
+                     self.mem_budget / 2**20)
+            self.lookahead = want
+
     def __call__(self, it: int) -> dict:
         """feed_fn protocol: return the batch for micro-iteration `it`,
         scheduling lookahead batches in the background."""
+        if self.auto:
+            now = time.perf_counter()
+            self._calls += 1
+            if self._last_exit is not None and self._calls > 2:
+                # skip the first couple of gaps — jit compilation noise
+                self._gaps.append(now - self._last_exit)
+            # first tune as soon as the warmup window fills, then
+            # periodically (datasets and step times can change phase)
+            if self._calls >= 8 and (self._calls == 8
+                                     or self._calls % 64 == 0):
+                self._maybe_retune()
         with self._lock:
             for ahead in range(it, it + self.lookahead + 1):
                 if ahead not in self._futures:
@@ -158,6 +252,8 @@ class Feeder:
         feeds = fut.result()
         if self.to_device is not None:
             feeds = self.to_device(feeds)
+        if self.auto:
+            self._last_exit = time.perf_counter()
         return feeds
 
     def close(self) -> None:
@@ -185,9 +281,11 @@ def feeder_from_layer(lp, phase: str, *, rank: int = 0, world: int = 1,
             from .datasets import CachedDataset
             ds = CachedDataset(ds)
         shuffle = bool(p.shuffle) and phase == "TRAIN"
+        # threads=0 (prototxt default) -> auto mode; prefetch seeds the
+        # initial lookahead window (reference data_param.prefetch)
         return Feeder(ds, tf, p.batch_size, rank=rank, world=world,
                       shuffle=shuffle, top_names=tops,
-                      threads=p.threads or 2,
+                      threads=p.threads, lookahead=max(p.prefetch, 1),
                       device_transform=device_transform)
     if lp.type == "ImageData":
         p = lp.image_data_param

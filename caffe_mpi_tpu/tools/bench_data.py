@@ -32,10 +32,10 @@ def _make_records(n, shape, seed=0):
     return imgs, labels
 
 
-def _feeder_for(backend, workdir, imgs, labels, batch, crop):
-    from ..data import DataTransformer, Feeder
-    from ..data.datasets import DatumFileDataset, encode_datum, open_dataset
-    from ..proto import TransformationParameter
+def _write_db(backend, workdir, imgs, labels):
+    """Serialize the synthetic dataset once per backend; returns the path
+    (or HDF5 source-list path) the per-sweep feeders open."""
+    from ..data.datasets import DatumFileDataset, encode_datum
 
     n = len(labels)
     recs = ((f"{i:08d}".encode(), encode_datum(imgs[i], int(labels[i])))
@@ -44,39 +44,46 @@ def _feeder_for(backend, workdir, imgs, labels, batch, crop):
         from ..data.lmdb_io import write_lmdb
         path = os.path.join(workdir, "b_lmdb")
         write_lmdb(path, recs)
-        ds = open_dataset("LMDB", path)
     elif backend == "leveldb":
         from ..data.leveldb_io import write_leveldb
         path = os.path.join(workdir, "b_leveldb")
         write_leveldb(path, list(recs), compress=True)
-        ds = open_dataset("LEVELDB", path)
     elif backend == "datumfile":
         path = os.path.join(workdir, "b.datumdb")
         DatumFileDataset.write(path, (r for _, r in recs))
-        ds = open_dataset("DATUMFILE", path)
     elif backend == "hdf5":
         import h5py
-        path = os.path.join(workdir, "b.h5")
-        with h5py.File(path, "w") as f:
+        h5 = os.path.join(workdir, "b.h5")
+        with h5py.File(h5, "w") as f:
             f["data"] = imgs
             f["label"] = labels.astype(np.int64)
-        src = os.path.join(workdir, "b_src.txt")
-        with open(src, "w") as f:
-            f.write(path + "\n")
+        path = os.path.join(workdir, "b_src.txt")
+        with open(path, "w") as f:
+            f.write(h5 + "\n")
+    else:
+        raise ValueError(backend)
+    return path
+
+
+def _feeder_for(backend, path, batch, crop, threads=0):
+    from ..data import DataTransformer, Feeder
+    from ..data.datasets import open_dataset
+    from ..proto import TransformationParameter
+
+    if backend == "hdf5":
         from ..data.feeder import HDF5Feeder
         from ..proto import NetParameter
         lp = NetParameter.from_text(
             'layer { name: "h" type: "HDF5Data" top: "data" top: "label"\n'
-            f'  hdf5_data_param {{ source: "{src}" batch_size: {batch} '
+            f'  hdf5_data_param {{ source: "{path}" batch_size: {batch} '
             'shuffle: true } }').layer[0]
         return HDF5Feeder(lp)
-    else:
-        raise ValueError(backend)
+    ds = open_dataset(backend.upper(), path)
     tp = TransformationParameter.from_text(
         f"crop_size: {crop} mirror: true mean_value: 104 "
         "mean_value: 117 mean_value: 123")
     return Feeder(ds, DataTransformer(tp, "TRAIN"), batch_size=batch,
-                  shuffle=True)
+                  shuffle=True, threads=threads)
 
 
 def main(argv=None) -> int:
@@ -91,8 +98,13 @@ def main(argv=None) -> int:
                    action="store_true",
                    help="stage raw uint8 + aug decisions (the in-graph "
                    "transform feed path) instead of transforming on host")
+    p.add_argument("-threads", "--threads", default="0",
+                   help="comma list of Feeder thread counts to sweep "
+                   "(0 = auto mode, the prototxt default) — shows "
+                   "multi-core scaling of the host pipeline")
     args = p.parse_args(argv)
     shape = tuple(int(x) for x in args.shape.split("x"))
+    sweeps = [int(t) for t in args.threads.split(",")]
 
     imgs, labels = _make_records(args.n, shape)
     iters = max(args.n // args.batch, 1)
@@ -100,28 +112,36 @@ def main(argv=None) -> int:
     with tempfile.TemporaryDirectory() as workdir:
         for backend in args.backends.split(","):
             t_build = time.perf_counter()
-            feeder = _feeder_for(backend, workdir, imgs, labels,
-                                 args.batch, args.crop)
-            if args.device_transform:
-                if not hasattr(feeder, "device_transform"):
-                    print(f"{backend:>10}: n/a (no device-transform path)")
-                    close = getattr(feeder, "close", None)
-                    if close:
-                        close()
-                    continue
-                feeder.device_transform = True
+            path = _write_db(backend, workdir, imgs, labels)
             build_s = time.perf_counter() - t_build
-            feeder(0)  # warm caches / thread pools
-            t0 = time.perf_counter()
-            for it in range(1, iters + 1):
-                feeder(it)
-            dt = time.perf_counter() - t0
-            close = getattr(feeder, "close", None)
-            if close:
-                close()
-            print(f"{backend:>10}: {args.batch * iters / dt:8.0f} img/s "
-                  f"({args.batch}x{args.shape}, crop {args.crop}, {mode}, "
-                  f"build {build_s:.1f}s)")
+            # HDF5Feeder has no thread pool — the sweep would print
+            # identical single-threaded runs under misleading labels
+            backend_sweeps = [None] if backend == "hdf5" else sweeps
+            for threads in backend_sweeps:
+                feeder = _feeder_for(backend, path, args.batch, args.crop,
+                                     threads or 0)
+                if args.device_transform:
+                    if not hasattr(feeder, "device_transform"):
+                        print(f"{backend:>10}: n/a "
+                              "(no device-transform path)")
+                        close = getattr(feeder, "close", None)
+                        if close:
+                            close()
+                        break
+                    feeder.device_transform = True
+                feeder(0)  # warm caches / thread pools
+                t0 = time.perf_counter()
+                for it in range(1, iters + 1):
+                    feeder(it)
+                dt = time.perf_counter() - t0
+                close = getattr(feeder, "close", None)
+                if close:
+                    close()
+                tdesc = ("threads n/a" if threads is None
+                         else "auto" if threads == 0 else f"t={threads}")
+                print(f"{backend:>10}: {args.batch * iters / dt:8.0f} img/s "
+                      f"({args.batch}x{args.shape}, crop {args.crop}, "
+                      f"{mode}, {tdesc}, build {build_s:.1f}s)")
     return 0
 
 

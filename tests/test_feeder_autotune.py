@@ -1,0 +1,100 @@
+"""Prefetch auto-tuning (reference data_layer.cpp:46-113).
+
+The reference sizes parser/transformer thread counts at iteration 0 from
+free GPU memory and net cost; the Feeder's analogue re-sizes the
+lookahead window from measured batch-build time vs consumer step time,
+bounded by a host-RAM budget for in-flight batches. threads=0 (the
+prototxt default, caffe.proto:840) enables it; explicit threads>0 pins
+the knobs, like the reference's explicit threads+parser_threads pair.
+"""
+
+import time
+
+import numpy as np
+
+from caffe_mpi_tpu.data.feeder import _LOOKAHEAD_HARD_CAP, Feeder
+
+
+class _TimedDataset:
+    """Synthetic dataset with a controllable per-record cost."""
+
+    def __init__(self, n=4096, delay=0.0, shape=(3, 8, 8)):
+        self.n = n
+        self.delay = delay
+        self.shape = shape
+
+    def __len__(self):
+        return self.n
+
+    def get(self, i):
+        if self.delay:
+            time.sleep(self.delay)
+        img = np.full(self.shape, i % 251, np.uint8)
+        return img, i % 10
+
+
+def _drive(feeder, iters, step_time=0.0):
+    for it in range(iters):
+        feeder(it)
+        if step_time:
+            time.sleep(step_time)
+    feeder.close()
+
+
+def test_slow_builds_grow_lookahead():
+    # building a batch takes ~8ms (4 records x 2ms), consumer is
+    # immediate -> supply must run many batches ahead
+    ds = _TimedDataset(delay=0.002)
+    f = Feeder(ds, None, batch_size=4, threads=0, lookahead=1)
+    assert f.auto
+    _drive(f, 16)
+    assert f.lookahead > 1
+
+
+def test_fast_builds_shrink_lookahead():
+    # building is instant, consumer sleeps 5ms per step -> one batch of
+    # lookahead suffices; an oversized initial window contracts
+    ds = _TimedDataset(delay=0.0)
+    f = Feeder(ds, None, batch_size=2, threads=0, lookahead=12)
+    _drive(f, 16, step_time=0.005)
+    assert f.lookahead <= 3
+
+
+def test_memory_budget_caps_lookahead():
+    # batch = 4 x 3x8x8 uint8 + labels ~= 800 B; budget of 3 batches
+    # caps the window at 2 regardless of the build/step ratio
+    ds = _TimedDataset(delay=0.002)
+    f = Feeder(ds, None, batch_size=4, threads=0, lookahead=1,
+               mem_budget=3 * (4 * 3 * 8 * 8 + 4 * 4))
+    _drive(f, 16)
+    assert 1 <= f.lookahead <= 2
+
+
+def test_hard_cap():
+    ds = _TimedDataset(delay=0.002)
+    f = Feeder(ds, None, batch_size=4, threads=0, lookahead=1)
+    _drive(f, 16)
+    assert f.lookahead <= _LOOKAHEAD_HARD_CAP
+
+
+def test_explicit_threads_disable_tuning():
+    ds = _TimedDataset(delay=0.002)
+    f = Feeder(ds, None, batch_size=4, threads=2, lookahead=3)
+    assert not f.auto
+    _drive(f, 16)
+    assert f.lookahead == 3 and f.threads == 2
+
+
+def test_auto_mode_is_deterministic():
+    # tuning changes scheduling, never record->slot assignment
+    ds = _TimedDataset(delay=0.001)
+    a = Feeder(ds, None, batch_size=4, threads=0, lookahead=1,
+               shuffle=True, seed=7)
+    b = Feeder(ds, None, batch_size=4, threads=3, lookahead=8,
+               shuffle=True, seed=7)
+    batches_a = [a(i) for i in range(12)]
+    batches_b = [b(i) for i in range(12)]
+    a.close(), b.close()
+    for fa, fb in zip(batches_a, batches_b):
+        for k in fa:
+            np.testing.assert_array_equal(fa[k], fb[k])

@@ -46,6 +46,8 @@ def _default_mem_budget() -> int:
         phys = os.sysconf("SC_PHYS_PAGES") * os.sysconf("SC_PAGE_SIZE")
     except (ValueError, OSError, AttributeError):
         phys = 8 << 30
+    if phys <= 0:  # sysconf can return -1 for "name known, no value"
+        phys = 8 << 30
     return min(phys // 4, 2 << 30)
 
 

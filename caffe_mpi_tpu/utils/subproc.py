@@ -1,0 +1,86 @@
+"""Watched-subprocess containment for device work.
+
+The TPU chip is single-claim and a dead tunnel hangs inside C++ jax
+calls where no Python signal can run (CLAUDE.md). Every tool that
+touches the device therefore runs the device work in a child process
+with a hard deadline — and the child must be killpg'd AND reaped on
+every exit path: an orphan keeps the chip claimed (every later probe
+then hangs, indistinguishable from a dead tunnel), and an unreaped
+zombie pollutes the `ps` sweep the operator uses to find claim holders.
+
+Shared by tools/tpu_validation.py and tools/bench_models.py (bench.py
+keeps subprocess.run: its child is the direct device process with no
+grandchildren, and run() reaps on timeout).
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import signal
+import subprocess
+
+# pgids of live contained children: killed from atexit AND from
+# SIGTERM/SIGINT — a `timeout`/`kill` on the PARENT otherwise leaves the
+# child alive in its own session, holding the chip (observed live: the
+# orphan claimed the TPU for >15 min and every probe looked tunnel-dead)
+_ACTIVE: set[int] = set()
+_HOOKED = False
+
+
+def _reap_all(signum=None, frame=None):
+    for pgid in list(_ACTIVE):
+        try:
+            os.killpg(pgid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+    if signum is not None:  # re-deliver default behavior
+        signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+
+def _install_hooks():
+    global _HOOKED
+    if _HOOKED:
+        return
+    _HOOKED = True
+    atexit.register(_reap_all)
+    for sig in (signal.SIGTERM, signal.SIGINT, signal.SIGHUP):
+        try:
+            signal.signal(sig, _reap_all)
+        except (ValueError, OSError):  # non-main thread / unsupported
+            pass
+
+
+def run_contained(cmd: list[str], timeout: float, cwd: str | None = None,
+                  env: dict | None = None):
+    """Run cmd in its own process group with a hard deadline.
+
+    Returns (returncode|None, stdout, stderr) — returncode None means
+    the deadline expired. The group is SIGKILLed and the child reaped on
+    every exit path, including the parent being SIGTERM'd.
+    """
+    _install_hooks()
+    proc = subprocess.Popen(cmd, cwd=cwd, env=env, text=True,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            start_new_session=True)
+    _ACTIVE.add(proc.pid)
+    try:
+        out, err = proc.communicate(timeout=timeout)
+        return proc.returncode, out, err
+    except subprocess.TimeoutExpired:
+        _kill_group(proc)
+        # child is now SIGKILLed: drain pipes and reap the zombie
+        out, err = proc.communicate()
+        return None, out, err
+    finally:
+        _kill_group(proc)
+        proc.wait()
+        _ACTIVE.discard(proc.pid)
+
+
+def _kill_group(proc: subprocess.Popen) -> None:
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass

@@ -1,60 +1,162 @@
 """Image-classification web demo (reference examples/web_demo/app.py).
 
-Flask app serving a single endpoint that classifies an uploaded image with
-a pycaffe Classifier. Flask is not part of the baked image; the app errors
-with instructions if it is missing.
+The reference serves a Flask+Tornado app with an upload form and a
+classify-by-URL endpoint around a pycaffe Classifier. Flask is not in
+this image — and a demo that errors out is no demo — so this is built on
+the stdlib `http.server` instead (ThreadingHTTPServer), with the same
+surface:
 
-    python examples/web_demo/app.py -model deploy.prototxt -weights w.caffemodel
+  GET  /                    upload form
+  POST /classify            multipart/form-data file field "image", or a
+                            raw image body (curl --data-binary)
+  GET  /classify_path?path= classify a file under --image-root
+                            (the zero-egress analogue of the reference's
+                            /classify_url, which fetched from the web)
+
+Responses are JSON top-5 {label, score} like the reference's result
+tuples.
+
+    python examples/web_demo/app.py -model deploy.prototxt \
+        -weights w.caffemodel [-labels synset.txt] [-port 5000]
 """
 
+from __future__ import annotations
+
 import argparse
+import email
+import email.policy
 import io as _io
+import json
+import os
 import sys
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+_FORM = (b"<html><body><h3>caffe_mpi_tpu classification demo</h3>"
+         b"<form method=post action=/classify enctype=multipart/form-data>"
+         b"<input type=file name=image> "
+         b"<input type=submit value=Classify></form></body></html>")
 
-def make_app(model: str, weights: str, labels_file: str | None = None):
-    try:
-        import flask
-    except ImportError:
-        raise SystemExit(
-            "The web demo requires flask, which is not installed in this "
-            "environment (pip install flask)."
-        )
+
+def _extract_image_bytes(body: bytes, content_type: str) -> bytes:
+    """Pull the uploaded file out of a multipart/form-data body (stdlib
+    email parser — the cgi module is deprecated); raw bodies pass
+    through."""
+    if content_type and content_type.startswith("multipart/"):
+        msg = email.message_from_bytes(
+            b"Content-Type: " + content_type.encode() + b"\r\n\r\n" + body,
+            policy=email.policy.HTTP)
+        fallback = None
+        for part in msg.iter_parts():
+            payload = part.get_payload(decode=True)
+            if not payload:
+                continue
+            name = part.get_param("name", header="content-disposition")
+            if name == "image":
+                return payload
+            # a form may carry extra fields; prefer any part that looks
+            # like a file upload over bare text fields
+            if fallback is None and part.get_filename():
+                fallback = payload
+        if fallback is not None:
+            return fallback
+        raise ValueError('no "image" file part in multipart body')
+    return body
+
+
+def _decode(img_bytes: bytes) -> np.ndarray:
+    from PIL import Image
+    img = Image.open(_io.BytesIO(img_bytes)).convert("RGB")
+    return np.asarray(img, np.float32) / 255.0
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # injected by make_server:
+    clf = None
+    labels = None
+    image_root = None
+
+    def _json(self, code: int, obj) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _classify(self, img: np.ndarray) -> None:
+        preds = self.clf.predict([img], oversample=False)[0]
+        top = np.argsort(-preds)[:5]
+        self._json(200, {"predictions": [
+            {"label": self.labels[i] if self.labels else int(i),
+             "score": float(preds[i])} for i in top]})
+
+    def do_GET(self):
+        url = urlparse(self.path)
+        if url.path == "/":
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Length", str(len(_FORM)))
+            self.end_headers()
+            self.wfile.write(_FORM)
+            return
+        if url.path == "/classify_path":
+            if not self.image_root:
+                return self._json(403, {"error": "no --image-root given"})
+            rel = parse_qs(url.query).get("path", [""])[0]
+            full = os.path.realpath(os.path.join(self.image_root, rel))
+            root = os.path.realpath(self.image_root)
+            if not full.startswith(root + os.sep):
+                return self._json(403, {"error": "path outside image root"})
+            try:
+                with open(full, "rb") as f:
+                    raw = f.read()
+            except OSError as e:
+                return self._json(404, {"error": str(e)})
+            try:
+                img = _decode(raw)
+            except Exception as e:  # exists but is not an image -> 400
+                return self._json(
+                    400, {"error": f"could not decode image: {e}"})
+            return self._classify(img)
+        self._json(404, {"error": f"no route {url.path}"})
+
+    def do_POST(self):
+        if urlparse(self.path).path != "/classify":
+            return self._json(404, {"error": "POST /classify"})
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        try:
+            img = _decode(_extract_image_bytes(
+                body, self.headers.get("Content-Type", "")))
+        except Exception as e:  # bad upload is a client error, not a crash
+            return self._json(400, {"error": f"could not decode image: {e}"})
+        self._classify(img)
+
+    def log_message(self, fmt, *args):  # quiet by default
+        if os.environ.get("WEB_DEMO_VERBOSE"):
+            sys.stderr.write(fmt % args + "\n")
+
+
+def make_server(model: str, weights: str, labels_file: str | None = None,
+                image_root: str | None = None, port: int = 5000,
+                host: str = "127.0.0.1") -> ThreadingHTTPServer:
+    """Build the demo server (port=0 picks an ephemeral port — tests)."""
     import caffe_mpi_tpu.pycaffe as caffe
 
-    clf = caffe.Classifier(model, weights)
     labels = None
     if labels_file:
         with open(labels_file) as f:
-            labels = [l.strip() for l in f]
+            labels = [line.strip() for line in f]
 
-    app = flask.Flask(__name__)
-
-    @app.route("/classify", methods=["POST"])
-    def classify():
-        from PIL import Image
-        file = flask.request.files["image"]
-        img = np.asarray(Image.open(_io.BytesIO(file.read())).convert("RGB"),
-                         np.float32) / 255.0
-        preds = clf.predict([img], oversample=False)[0]
-        top = np.argsort(-preds)[:5]
-        return flask.jsonify({
-            "predictions": [
-                {"label": labels[i] if labels else int(i),
-                 "score": float(preds[i])} for i in top
-            ]
-        })
-
-    @app.route("/")
-    def index():
-        return ("<form method=post action=/classify "
-                "enctype=multipart/form-data>"
-                "<input type=file name=image>"
-                "<input type=submit value=Classify></form>")
-
-    return app
+    handler = type("Handler", (_Handler,), {
+        "clf": caffe.Classifier(model, weights),
+        "labels": labels,
+        "image_root": image_root,
+    })
+    return ThreadingHTTPServer((host, port), handler)
 
 
 if __name__ == "__main__":
@@ -62,7 +164,11 @@ if __name__ == "__main__":
     p.add_argument("-model", required=True)
     p.add_argument("-weights", required=True)
     p.add_argument("-labels", default=None)
+    p.add_argument("-image-root", default=None,
+                   help="allow GET /classify_path under this directory")
     p.add_argument("-port", type=int, default=5000)
     args = p.parse_args()
-    make_app(args.model, args.weights, args.labels).run(
-        host="127.0.0.1", port=args.port)
+    srv = make_server(args.model, args.weights, args.labels,
+                      args.image_root, args.port)
+    print(f"serving on http://127.0.0.1:{srv.server_address[1]}")
+    srv.serve_forever()

@@ -1,0 +1,146 @@
+"""The web demo serves real HTTP with no third-party deps (reference
+examples/web_demo/app.py ran on Flask+Tornado; here stdlib http.server,
+so it actually runs in this image)."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import caffe_mpi_tpu.pycaffe as caffe
+
+
+@pytest.fixture(scope="module")
+def demo_server(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("webdemo")
+    model = tmp / "deploy.prototxt"
+    model.write_text("""
+    name: "toy"
+    layer { name: "data" type: "Input" top: "data"
+            input_param { shape { dim: 1 dim: 3 dim: 8 dim: 8 } } }
+    layer { name: "ip" type: "InnerProduct" bottom: "data" top: "score"
+            inner_product_param { num_output: 5
+              weight_filler { type: "xavier" } } }
+    layer { name: "prob" type: "Softmax" bottom: "score" top: "prob" }
+    """)
+    net = caffe.Net(str(model), caffe.TEST)
+    weights = str(tmp / "w.caffemodel")
+    net.save(weights)
+    labels = tmp / "labels.txt"
+    labels.write_text("\n".join(f"class_{i}" for i in range(5)))
+
+    # an image to serve via /classify_path
+    from PIL import Image
+    img = Image.fromarray(
+        np.random.RandomState(0).randint(0, 255, (12, 12, 3), np.uint8))
+    img.save(tmp / "cat.png")
+
+    import importlib.util
+    import os
+    app_py = os.path.join(os.path.dirname(__file__), "..",
+                          "examples", "web_demo", "app.py")
+    spec = importlib.util.spec_from_file_location("web_demo_app", app_py)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    srv = mod.make_server(str(model), weights, str(labels),
+                          image_root=str(tmp), port=0)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}", tmp
+    srv.shutdown()
+
+
+def _png_bytes():
+    import io
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(np.random.RandomState(1).randint(
+        0, 255, (10, 10, 3), np.uint8)).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def test_index_form(demo_server):
+    base, _ = demo_server
+    html = urllib.request.urlopen(base + "/").read()
+    assert b"multipart/form-data" in html
+
+
+def test_classify_raw_post(demo_server):
+    base, _ = demo_server
+    req = urllib.request.Request(base + "/classify", data=_png_bytes(),
+                                 headers={"Content-Type": "image/png"})
+    out = json.loads(urllib.request.urlopen(req).read())
+    preds = out["predictions"]
+    assert len(preds) == 5
+    assert abs(sum(p["score"] for p in preds) - 1.0) < 1e-3
+    assert preds[0]["label"].startswith("class_")
+    scores = [p["score"] for p in preds]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_classify_multipart_post(demo_server):
+    base, _ = demo_server
+    boundary = "xyzzy42"
+    body = (f"--{boundary}\r\n"
+            'Content-Disposition: form-data; name="image"; '
+            'filename="a.png"\r\n'
+            "Content-Type: image/png\r\n\r\n").encode() + _png_bytes() + \
+        f"\r\n--{boundary}--\r\n".encode()
+    req = urllib.request.Request(
+        base + "/classify", data=body,
+        headers={"Content-Type":
+                 f"multipart/form-data; boundary={boundary}"})
+    out = json.loads(urllib.request.urlopen(req).read())
+    assert len(out["predictions"]) == 5
+
+
+def test_multipart_extra_field_before_image(demo_server):
+    # a text form field ahead of the file part must not be mistaken for
+    # the image (extraction selects the part named "image")
+    base, _ = demo_server
+    boundary = "xyzzy43"
+    body = (f"--{boundary}\r\n"
+            'Content-Disposition: form-data; name="note"\r\n\r\n'
+            "hello\r\n"
+            f"--{boundary}\r\n"
+            'Content-Disposition: form-data; name="image"; '
+            'filename="a.png"\r\n'
+            "Content-Type: image/png\r\n\r\n").encode() + _png_bytes() + \
+        f"\r\n--{boundary}--\r\n".encode()
+    req = urllib.request.Request(
+        base + "/classify", data=body,
+        headers={"Content-Type":
+                 f"multipart/form-data; boundary={boundary}"})
+    out = json.loads(urllib.request.urlopen(req).read())
+    assert len(out["predictions"]) == 5
+
+
+def test_classify_path_non_image_is_400(demo_server):
+    base, tmp = demo_server
+    (tmp / "notes.txt").write_text("not an image")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(base + "/classify_path?path=notes.txt")
+    assert e.value.code == 400
+
+
+def test_classify_path_and_traversal_guard(demo_server):
+    base, tmp = demo_server
+    out = json.loads(urllib.request.urlopen(
+        base + "/classify_path?path=cat.png").read())
+    assert len(out["predictions"]) == 5
+    # escaping the image root is refused
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(base + "/classify_path?path=../../etc/passwd")
+    assert e.value.code == 403
+
+
+def test_bad_upload_is_400(demo_server):
+    base, _ = demo_server
+    req = urllib.request.Request(base + "/classify", data=b"not an image",
+                                 headers={"Content-Type": "image/png"})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req)
+    assert e.value.code == 400

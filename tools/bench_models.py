@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Model-zoo throughput sweep on the real TPU (docs/benchmarks.md source).
+
+For each (model, batch) the full training step — forward, backward,
+optimizer update — runs as one jit-compiled XLA program on synthetic
+on-device data (pipeline excluded; `bench_data` measures that side), the
+same path `caffe train` uses. Reports img/s and model-FLOPs MFU.
+
+Containment mirrors bench.py: every model runs in a watched subprocess in
+its own process group with a hard deadline, so one hang (dead tunnel)
+cannot kill the sweep or leave a child holding the chip claim.
+
+Usage:
+    python tools/bench_models.py [model ...]   # default: the zoo ladder
+    python tools/bench_models.py resnet50 resnet50_fp16
+
+Reference anchors (BASELINE.md): CaffeNet 256x20 imgs in 19.2 s on K40
+(266.7 img/s); 16xP40 cluster speedups 14.65x/14.25x/15.34x for
+AlexNet/GoogLeNet/ResNet over one P40.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+from caffe_mpi_tpu.utils.subproc import run_contained  # noqa: E402
+
+# model key -> (solver path, batch override or None=prototxt, note)
+SWEEP = {
+    "alexnet": ("models/alexnet/solver.prototxt", 256, "headline topology"),
+    "googlenet": ("models/googlenet/solver.prototxt", 128,
+                  "reference 16-P40 run used global batch 128"),
+    "resnet50": ("models/resnet50/solver.prototxt", 32,
+                 "reference per-GPU batch"),
+    "resnet50_b256": ("models/resnet50/solver.prototxt", 256,
+                      "DGX-1-recipe batch"),
+    "resnet50_fp16": ("models/resnet50/solver_fp16.prototxt", 32,
+                      "bf16 compute policy (FLOAT16->bf16 mapping)"),
+    "vgg16": ("models/vgg16/solver.prototxt", 32, None),
+    "inception_v3": ("models/inception_v3/solver.prototxt", 32, None),
+    "cifar10_quick": ("models/cifar10_quick/solver.prototxt", 100, None),
+}
+DEFAULT = ["alexnet", "googlenet", "resnet50", "resnet50_b256",
+           "resnet50_fp16", "vgg16", "inception_v3"]
+_CHILD = os.environ.get("CAFFE_BENCH_MODELS_CHILD")
+
+
+def bench_one(key: str) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from caffe_mpi_tpu.proto import NetParameter, SolverParameter
+    from caffe_mpi_tpu.solver import Solver
+    from caffe_mpi_tpu.utils.compile_cache import enable_compile_cache
+    from caffe_mpi_tpu.utils.flops import peak_flops, train_flops_per_image
+
+    enable_compile_cache(os.path.join(_ROOT, ".jax_cache"))
+    solver_path, batch, _note = SWEEP[key]
+    sp = SolverParameter.from_file(os.path.join(_ROOT, solver_path))
+    sp.max_iter = 10**9
+    sp.display = 0
+    sp.snapshot = 0
+    sp.test_interval = 0
+    npar = NetParameter.from_file(os.path.join(_ROOT, sp.net))
+    shapes = {}
+    for l in npar.layer:
+        if l.type == "Input":
+            if any(str(getattr(r, "phase", "")) == "TEST"
+                   for r in (l.include or [])):
+                continue  # batch override + feeds track the TRAIN net
+            for top, shp in zip(l.top, l.input_param.shape):
+                dims = list(shp.dim)
+                if batch:
+                    dims[0] = batch
+                    shp.dim[0] = batch
+                shapes[top] = dims
+    sp.net = ""
+    sp.net_param = npar
+    solver = Solver(sp, model_dir=_ROOT)
+
+    r = np.random.RandomState(0)
+    feeds = {}
+    for top, dims in shapes.items():
+        if top == "label":
+            feeds[top] = jnp.asarray(r.randint(0, 1000, dims[0]))
+        else:
+            feeds[top] = jnp.asarray(r.randn(*dims).astype(np.float32))
+    feed_fn = lambda it: feeds
+
+    iters, warmup = 20, 3
+    solver.step(warmup, feed_fn)
+    jax.block_until_ready(solver.params)
+    t0 = time.perf_counter()
+    solver.step(iters, feed_fn)
+    jax.block_until_ready(solver.params)
+    dt = time.perf_counter() - t0
+
+    n = next(iter(shapes.values()))[0]
+    img_s = n * iters / dt
+    flops_img = train_flops_per_image(solver.net)
+    device = jax.devices()[0]
+    peak = peak_flops(device)
+    achieved = flops_img * img_s
+    return {
+        "model": key, "batch": n, "img_per_s": round(img_s, 1),
+        "step_ms": round(dt / iters * 1e3, 2),
+        "tflops_per_s": round(achieved / 1e12, 2),
+        "mfu": round(achieved / peak, 4) if peak else None,
+        "device": device.device_kind,
+    }
+
+
+def main() -> int:
+    if _CHILD:
+        print(json.dumps(bench_one(_CHILD)))
+        return 0
+    keys = sys.argv[1:] or DEFAULT
+    bad = [k for k in keys if k not in SWEEP]
+    if bad:
+        print(f"unknown model keys: {bad}; known: {sorted(SWEEP)}")
+        return 2
+    results = []
+    for key in keys:
+        env = dict(os.environ, CAFFE_BENCH_MODELS_CHILD=key)
+        # generous deadline: first-run compile of the big nets is slow
+        rc, out, err = run_contained([sys.executable, __file__], 900,
+                                     cwd=_ROOT, env=env)
+        if rc is None:
+            print(f"{key:>14}: TIMEOUT (900s)", flush=True)
+        elif rc == 0 and out.strip():
+            rec = json.loads(out.strip().splitlines()[-1])
+            results.append(rec)
+            mfu = rec["mfu"]
+            mfu_s = f"MFU {mfu:.1%}" if mfu is not None else "MFU n/a"
+            print(f"{key:>14}: {rec['img_per_s']:8.1f} img/s  "
+                  f"b{rec['batch']}  {rec['step_ms']:7.2f} ms/step  "
+                  f"{mfu_s}", flush=True)
+        else:
+            tail = err.strip().splitlines()[-1:] or ["(no output)"]
+            print(f"{key:>14}: FAILED rc={rc} {tail[0][-200:]}", flush=True)
+    if results:
+        with open(os.path.join(_ROOT, "bench_models.json"), "w") as f:
+            json.dump(results, f, indent=1)
+        print(f"wrote bench_models.json ({len(results)} entries)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

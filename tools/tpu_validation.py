@@ -16,23 +16,28 @@ Writes a summary to tpu_validation.log (repo root).
 from __future__ import annotations
 
 import os
-import subprocess
 import sys
 import time
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+from caffe_mpi_tpu.utils.subproc import run_contained  # noqa: E402
 
 
 def run(name, cmd, timeout, log):
     print(f"=== {name}: {' '.join(cmd)}", flush=True)
     t0 = time.time()
-    try:
-        r = subprocess.run(cmd, cwd=_ROOT, timeout=timeout,
-                           capture_output=True, text=True)
-        ok = r.returncode == 0
-        tail = (r.stdout + r.stderr).strip().splitlines()[-12:]
-    except subprocess.TimeoutExpired:
+    # Own process group + killpg + reap on every exit path: a child left
+    # behind (e.g. this script gets pkill'd, or a hang outlives the
+    # timeout) keeps the single TPU chip CLAIMED and every later probe
+    # times out looking exactly like a dead tunnel.
+    rc, out, err = run_contained(cmd, timeout, cwd=_ROOT)
+    if rc is None:
         ok, tail = False, [f"TIMEOUT after {timeout}s"]
+    else:
+        ok = rc == 0
+        tail = (out + err).strip().splitlines()[-12:]
     dt = time.time() - t0
     status = "OK" if ok else "FAIL"
     log.write(f"[{status}] {name} ({dt:.0f}s)\n")
@@ -45,6 +50,9 @@ def run(name, cmd, timeout, log):
 
 
 def main() -> int:
+    if {"-h", "--help"} & set(sys.argv[1:]):
+        print(__doc__)
+        return 0
     quick = "--quick" in sys.argv
     py = sys.executable
     with open(os.path.join(_ROOT, "tpu_validation.log"), "w") as log:

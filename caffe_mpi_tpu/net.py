@@ -334,6 +334,15 @@ class Net:
         """Params/state -> {layer_name: positional blob list} in the
         reference's blobs_ order (Net::ToProto)."""
         import numpy as np
+
+        def to_host(a):
+            # TP weights in multi-host runs span non-addressable devices;
+            # gather before the host copy (bare np.asarray raises there)
+            if isinstance(a, jax.Array) and not a.is_fully_addressable:
+                from jax.experimental import multihost_utils
+                a = multihost_utils.process_allgather(a, tiled=True)
+            return np.asarray(a, np.float32)
+
         out: dict[str, list] = {}
         for layer in self.layers:
             blobs = []
@@ -341,11 +350,9 @@ class Net:
                 if kind == "param":
                     owner = self.param_aliases.get((layer.name, pname),
                                                    (layer.name, pname))
-                    blobs.append(np.asarray(params[owner[0]][owner[1]],
-                                            np.float32))
+                    blobs.append(to_host(params[owner[0]][owner[1]]))
                 elif kind == "state":
-                    blobs.append(np.asarray(state[layer.name][pname],
-                                            np.float32))
+                    blobs.append(to_host(state[layer.name][pname]))
                 elif kind == "correction":
                     blobs.append(np.ones((1,), np.float32))
             if blobs:

@@ -145,6 +145,18 @@ class MeshPlan:
         ncclBcast of all weights, parallel.cpp:208-227)."""
         return jax.device_put(tree, self.replicated())
 
+    # -- ZeRO-1 optimizer-state sharding (beyond the reference) ---------
+    def zero_slot_sharding(self, shape) -> NamedSharding | None:
+        """Sharding for an optimizer slot under zero_stage 1: dim 0 split
+        over 'data' (the gradient-averaging axis doubles as the
+        slot-partition axis, à la ZeRO/Deepspeed stage 1). Returns None —
+        caller keeps the slot replicated — when dim 0 doesn't divide
+        n_data (small biases) or the mesh has no data parallelism."""
+        if self.n_data <= 1 or not shape or shape[0] % self.n_data:
+            return None
+        return NamedSharding(self.mesh,
+                             P(*(["data"] + [None] * (len(shape) - 1))))
+
     # -- tensor parallelism (beyond the reference's DP-only surface) ----
     def param_sharding_rules(self, rules: dict[str, tuple]):
         """Declare per-layer weight shardings over the 'model' axis.

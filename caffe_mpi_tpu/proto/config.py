@@ -931,6 +931,11 @@ class SolverParameter(Message):
     # TPU-native extension: device mesh shape for pjit sharding, replacing
     # the reference's mpirun/GPU-list topology flags.
     mesh_data_axis: int = 0
+    # TPU-native extension (beyond the reference): 1 = shard optimizer
+    # slots over the 'data' mesh axis (ZeRO-1) — grads reduce-scatter,
+    # updates compute on 1/N of each param, new params all-gather; slot
+    # memory drops to 1/N per chip. 0 = replicated (reference behavior).
+    zero_stage: int = 0
 
 
 SOLVER_TYPE_NAMES = {

@@ -117,6 +117,18 @@ class Solver:
         self._param_shardings = param_shardings
         if param_shardings and mesh is None:
             raise ValueError("param_shardings requires a mesh")
+        # ZeRO-1 (TPU extension, proto zero_stage): optimizer slots live
+        # sharded over the 'data' axis; the update computes on 1/N of
+        # each param and the result all-gathers. {(layer,param): sharding}
+        # for every slot actually sharded — consulted inside the step.
+        zero = int(getattr(sp, "zero_stage", 0) or 0)
+        if zero not in (0, 1):
+            raise ValueError(f"zero_stage {zero} unsupported (0 or 1)")
+        if zero and mesh is None:
+            raise ValueError("zero_stage: 1 requires a device mesh "
+                             "(-gpu all or -mesh data=N)")
+        self._zero = zero
+        self._zero_shardings: dict[tuple, object] = {}
         if param_shardings:
             unknown = set(param_shardings) - set(self.params)
             if unknown:
@@ -202,6 +214,26 @@ class Solver:
         else:
             self.params = mesh.replicate(self.params)
             self.opt_state = mesh.replicate(self.opt_state)
+        if self._zero:
+            # ZeRO-1: re-place slots of replicated params split over
+            # 'data'. TP-sharded params keep their slots param-aligned
+            # (already partitioned over 'model').
+            self._zero_shardings = {}
+            tp_layers = set(self._param_shardings or ())
+            new_opt = {}
+            for ln, lo in self.opt_state.items():
+                new_opt[ln] = {}
+                for pn, slots in lo.items():
+                    zsh = (None if ln in tp_layers else
+                           mesh.zero_slot_sharding(
+                               self.params[ln][pn].shape))
+                    if zsh is None:
+                        new_opt[ln][pn] = slots
+                    else:
+                        self._zero_shardings[(ln, pn)] = zsh
+                        new_opt[ln][pn] = tuple(
+                            jax.device_put(s, zsh) for s in slots)
+            self.opt_state = new_opt
 
     # ------------------------------------------------------------------
     def _init_opt_state(self):
@@ -279,6 +311,8 @@ class Solver:
 
             new_params = {}
             new_opt = {}
+            zero_sh = self._zero_shardings
+            repl = self.mesh.replicated() if zero_sh else None
             for lname, lparams in params.items():
                 new_params[lname] = {}
                 new_opt[lname] = {}
@@ -290,9 +324,19 @@ class Solver:
                         new_params[lname][pname] = w
                         new_opt[lname][pname] = slots
                         continue
+                    zsh = zero_sh.get((lname, pname))
+                    if zsh is not None:
+                        # ZeRO-1: pin the gradient to the slot partition
+                        # (GSPMD lowers the psum of the batch-sharded
+                        # backward into a reduce-scatter), update 1/N of
+                        # the param on each device, all-gather the result
+                        # back to the replicated param layout.
+                        g = jax.lax.with_sharding_constraint(g, zsh)
                     w32 = w.astype(jnp.float32)
                     w2, slots2 = update_fn(w32, g, slots, hyper,
                                            decl.lr_mult, decl.decay_mult)
+                    if zsh is not None:
+                        w2 = jax.lax.with_sharding_constraint(w2, repl)
                     new_params[lname][pname] = w2.astype(w.dtype)
                     new_opt[lname][pname] = slots2
             return new_params, net_state, new_opt, loss_out, rate
@@ -471,6 +515,17 @@ class Solver:
         log.info("Snapshotting to %s + %s", model_path, state_path)
         return state_path
 
+    @staticmethod
+    def _to_host(a) -> np.ndarray:
+        """np.asarray that also works for arrays with REMOTE shards —
+        multi-host DP with zero_stage 1 (or TP) leaves slots spanning
+        non-addressable devices, where a bare np.asarray raises."""
+        if isinstance(a, jax.Array) and not a.is_fully_addressable:
+            from jax.experimental import multihost_utils
+            return np.asarray(multihost_utils.process_allgather(a,
+                                                                tiled=True))
+        return np.asarray(a)
+
     def _history_blobs(self) -> list:
         """Optimizer slots as the reference's flat history list: params in
         net order, slot-major (history[i + s*N] = slot s of param i;
@@ -481,7 +536,7 @@ class Solver:
         out = []
         for s in range(slots_per):
             for lname, pname, _ in decls:
-                out.append(np.asarray(self.opt_state[lname][pname][s]))
+                out.append(self._to_host(self.opt_state[lname][pname][s]))
         return out
 
     def _current_step(self) -> int:

@@ -87,11 +87,18 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def _classify(self, img: np.ndarray) -> None:
-        preds = self.clf.predict([img], oversample=False)[0]
-        top = np.argsort(-preds)[:5]
-        self._json(200, {"predictions": [
-            {"label": self.labels[i] if self.labels else int(i),
-             "score": float(preds[i])} for i in top]})
+        try:
+            preds = self.clf.predict([img], oversample=False)[0]
+            top = np.argsort(-preds)[:5]
+            body = {"predictions": [
+                # a short labels file falls back to the class index
+                # rather than crashing the handler mid-response
+                {"label": (self.labels[i] if self.labels
+                           and i < len(self.labels) else int(i)),
+                 "score": float(preds[i])} for i in top]}
+        except Exception as e:
+            return self._json(500, {"error": f"classification failed: {e}"})
+        self._json(200, body)
 
     def do_GET(self):
         url = urlparse(self.path)

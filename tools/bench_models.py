@@ -85,11 +85,20 @@ def bench_one(key: str) -> dict:
     sp.net_param = npar
     solver = Solver(sp, model_dir=_ROOT)
 
+    # class count = num_output of the layer feeding the loss (labels drawn
+    # beyond it would silently clamp in take_along_axis and skew the loss)
+    loss_bottoms = [l.bottom[0] for l in npar.layer
+                    if "Loss" in l.type and l.bottom]
+    n_classes = 1000
+    for l in npar.layer:
+        if l.type == "InnerProduct" and l.top and \
+                l.top[0] in loss_bottoms and l.inner_product_param.num_output:
+            n_classes = l.inner_product_param.num_output
     r = np.random.RandomState(0)
     feeds = {}
     for top, dims in shapes.items():
         if top == "label":
-            feeds[top] = jnp.asarray(r.randint(0, 1000, dims[0]))
+            feeds[top] = jnp.asarray(r.randint(0, n_classes, dims[0]))
         else:
             feeds[top] = jnp.asarray(r.randn(*dims).astype(np.float32))
     feed_fn = lambda it: feeds

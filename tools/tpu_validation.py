@@ -55,7 +55,10 @@ def main() -> int:
         return 0
     quick = "--quick" in sys.argv
     py = sys.executable
-    with open(os.path.join(_ROOT, "tpu_validation.log"), "w") as log:
+    # line-buffered: a SIGTERM'd run (timeout/Ctrl-C) keeps every entry
+    # written so far — partial hardware evidence is the valuable kind
+    with open(os.path.join(_ROOT, "tpu_validation.log"), "w",
+              buffering=1) as log:
         log.write(f"TPU validation @ {time.ctime()}\n")
         probe_ok = run(
             "probe",

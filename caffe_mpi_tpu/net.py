@@ -335,13 +335,13 @@ class Net:
         reference's blobs_ order (Net::ToProto)."""
         import numpy as np
 
+        from .parallel.mesh import to_host_array
+
         def to_host(a):
             # TP weights in multi-host runs span non-addressable devices;
-            # gather before the host copy (bare np.asarray raises there)
-            if isinstance(a, jax.Array) and not a.is_fully_addressable:
-                from jax.experimental import multihost_utils
-                a = multihost_utils.process_allgather(a, tiled=True)
-            return np.asarray(a, np.float32)
+            # to_host_array gathers them (collective — snapshot enters on
+            # all ranks and gates only the file writes on rank 0)
+            return to_host_array(a, np.float32)
 
         out: dict[str, list] = {}
         for layer in self.layers:

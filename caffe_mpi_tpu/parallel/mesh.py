@@ -77,6 +77,29 @@ def init_distributed(coordinator: str | None = None, num_processes: int | None =
                  jax.process_index(), jax.process_count())
 
 
+def to_host_array(a, dtype=None) -> np.ndarray:
+    """np.asarray that also works for arrays with REMOTE shards (multi-host
+    ZeRO-1 slots / TP weights), used by snapshot weight + history export.
+
+    Replicated arrays read a local replica — no collective, any rank may
+    call alone. The allgather branch IS collective: every process must
+    reach it, in the same order, with no interleaved training collectives
+    (callers serialize against the step loop)."""
+    if (isinstance(a, jax.Array) and not a.is_fully_addressable
+            and not a.is_fully_replicated):
+        from jax.experimental import multihost_utils
+        a = multihost_utils.process_allgather(a, tiled=True)
+    return np.asarray(a) if dtype is None else np.asarray(a, dtype)
+
+
+def needs_collective_gather(tree) -> bool:
+    """True if host-exporting `tree` involves a cross-process collective —
+    i.e. some leaf's shards are neither locally addressable nor replicated."""
+    return any(isinstance(a, jax.Array) and not a.is_fully_addressable
+               and not a.is_fully_replicated
+               for a in jax.tree.leaves(tree))
+
+
 def node_rank() -> int:
     """Reference Clusters::node_rank."""
     return jax.process_index()

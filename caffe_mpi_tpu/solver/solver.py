@@ -21,6 +21,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import threading
 import time
 from collections import deque
 from functools import partial
@@ -31,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..net import Net
+from ..parallel.mesh import needs_collective_gather
 from ..proto.config import NetParameter, NetState, SolverParameter, solver_type
 from ..proto.text_format import parse_file
 from . import lr_policy
@@ -398,7 +400,9 @@ class Solver:
             self.iter += 1
             n -= 1
             if sp.snapshot and self.iter % sp.snapshot == 0:
-                self.snapshot()
+                # interval snapshots don't stall the train loop (the
+                # reference's do: solver.cpp:339-344 writes inline)
+                self.snapshot(block=False)
         return float(last_loss) if last_loss is not None else float("nan")
 
     def solve(self, feed_fn: FeedFn, test_feed_fns=None) -> float:
@@ -406,6 +410,7 @@ class Solver:
         loss = self.step(self.sp.max_iter - self.iter, feed_fn, test_feed_fns)
         if self.should_snapshot_after_train():
             self.snapshot()
+        self.wait_snapshots()  # async interval writes land before return
         return loss
 
     def should_snapshot_after_train(self) -> bool:
@@ -485,58 +490,117 @@ class Solver:
     # weights (.caffemodel / .caffemodel.h5, readable by the reference) +
     # solver state (.solverstate.npz: iter, optimizer history, weights
     # pointer; the reference uses a SolverState binaryproto).
-    def snapshot(self) -> str:
+    def snapshot(self, block: bool = True) -> str:
+        """Two-file snapshot in the reference's own formats (solver.cpp
+        Snapshot; caffe.proto:303-308) — a reference build can resume our
+        snapshots and vice versa.
+
+        block=False (mid-training snapshots) hands the write to a
+        background thread while training races ahead — a TPU-native
+        advantage over the reference, whose snapshot stalls the train
+        loop for the full device->host copy + serialize
+        (solver.cpp:542-604). The capture is a device-side COPY (HBM to
+        HBM, dispatched async): jax arrays are immutable, but the jitted
+        step DONATES its input buffers, so the live pytrees' storage is
+        invalidated by the very next step — the copy breaks that
+        aliasing for a true point-in-time view. The device->host gather
+        then runs in the worker thread.
+
+        Multi-host note: the sharded-state gather is collective (all
+        ranks enter; only rank 0 writes) and MUST NOT interleave with
+        training collectives from another thread — so when exporting
+        would require a collective in a multi-process run, async mode
+        falls back to blocking (collective order then stays identical on
+        every rank)."""
+        if not block and jax.process_count() > 1 and needs_collective_gather(
+                (self.params, self.opt_state)):
+            block = True
+        if block:
+            view = (self.params, self.net_state, self.opt_state, self.iter,
+                    self._current_step())
+            self.wait_snapshots()
+            return self._write_snapshot(*view)
+        copy = lambda t: jax.tree.map(
+            lambda a: jnp.copy(a) if isinstance(a, jax.Array) else a, t)
+        view = (copy(self.params), copy(self.net_state),
+                copy(self.opt_state), self.iter, self._current_step())
+        self.wait_snapshots()  # at most one in flight, writes stay ordered
+        self._snapshot_thread = threading.Thread(
+            target=self._write_snapshot_guarded, args=view, daemon=True,
+            name="snapshot-writer")
+        self._snapshot_thread.start()
+        return ""
+
+    def wait_snapshots(self) -> None:
+        """Join any in-flight async snapshot (end of training / before a
+        blocking snapshot of the same files). Re-raises a failed async
+        write — a checkpoint the user believes exists but doesn't must
+        not exit 0."""
+        t = getattr(self, "_snapshot_thread", None)
+        if t is not None and t.is_alive():
+            t.join()
+        err = getattr(self, "_snapshot_error", None)
+        if err is not None:
+            self._snapshot_error = None
+            raise RuntimeError("async snapshot failed") from err
+
+    def _write_snapshot_guarded(self, *view) -> None:
+        try:
+            self._write_snapshot(*view)
+        except BaseException as e:  # surfaced by wait_snapshots
+            self._snapshot_error = e
+
+    def _write_snapshot(self, params, net_state, opt_state, it,
+                        current_step) -> str:
+        from .. import io as caffe_io
+        if self.rank != 0 and not needs_collective_gather(
+                (params, opt_state)):
+            # non-root with nothing collective to contribute: skip the
+            # full model device->host copy (costly over the tunnel)
+            return ""
+        weights = self.net.export_weights(params, net_state)
+        history = self._history_blobs(opt_state)
         if self.rank != 0:  # only root writes (solver.cpp:543)
             return ""
-        from .. import io as caffe_io
         prefix = self.sp.snapshot_prefix or "snapshot"
         os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
-        weights = self.net.export_weights(self.params, self.net_state)
         layer_types = {l.name: l.lp.type for l in self.net.layers}
         if str(self.sp.snapshot_format).upper() == "HDF5":
-            model_path = f"{prefix}_iter_{self.iter}.caffemodel.h5"
+            model_path = f"{prefix}_iter_{it}.caffemodel.h5"
             caffe_io.save_caffemodel_h5(model_path, weights)
+            state_path = f"{prefix}_iter_{it}.solverstate.h5"
+            caffe_io.save_solverstate_h5(state_path, it, model_path,
+                                         history, current_step)
         else:
-            model_path = f"{prefix}_iter_{self.iter}.caffemodel"
+            model_path = f"{prefix}_iter_{it}.caffemodel"
             caffe_io.save_caffemodel(model_path, weights,
                                      self.net.name, layer_types)
-        # solver state in the reference's own formats (caffe.proto:303-308):
-        # .solverstate binaryproto by default, .solverstate.h5 for HDF5 —
-        # a reference build can resume our snapshots and vice versa
-        history = self._history_blobs()
-        if str(self.sp.snapshot_format).upper() == "HDF5":
-            state_path = f"{prefix}_iter_{self.iter}.solverstate.h5"
-            caffe_io.save_solverstate_h5(state_path, self.iter, model_path,
-                                         history, self._current_step())
-        else:
-            state_path = f"{prefix}_iter_{self.iter}.solverstate"
-            caffe_io.save_solverstate(state_path, self.iter, model_path,
-                                      history, self._current_step())
+            state_path = f"{prefix}_iter_{it}.solverstate"
+            caffe_io.save_solverstate(state_path, it, model_path,
+                                      history, current_step)
         log.info("Snapshotting to %s + %s", model_path, state_path)
         return state_path
 
     @staticmethod
     def _to_host(a) -> np.ndarray:
-        """np.asarray that also works for arrays with REMOTE shards —
-        multi-host DP with zero_stage 1 (or TP) leaves slots spanning
-        non-addressable devices, where a bare np.asarray raises."""
-        if isinstance(a, jax.Array) and not a.is_fully_addressable:
-            from jax.experimental import multihost_utils
-            return np.asarray(multihost_utils.process_allgather(a,
-                                                                tiled=True))
-        return np.asarray(a)
+        """See parallel.mesh.to_host_array — gathers remote shards
+        (multi-host ZeRO-1 slots / TP weights) before the host copy."""
+        from ..parallel.mesh import to_host_array
+        return to_host_array(a)
 
-    def _history_blobs(self) -> list:
+    def _history_blobs(self, opt_state=None) -> list:
         """Optimizer slots as the reference's flat history list: params in
         net order, slot-major (history[i + s*N] = slot s of param i;
         sgd_solver.cpp PreSolve + adam_solver.cpp:37-39)."""
+        if opt_state is None:
+            opt_state = self.opt_state
         decls = list(self.net.learnable_param_decls())
-        slots_per = max((len(self.opt_state[l][p]) for l, p, _ in decls),
+        slots_per = max((len(opt_state[l][p]) for l, p, _ in decls),
                         default=0)
         out = []
         for s in range(slots_per):
             for lname, pname, _ in decls:
-                out.append(self._to_host(self.opt_state[lname][pname][s]))
+                out.append(self._to_host(opt_state[lname][pname][s]))
         return out
 
     def _current_step(self) -> int:

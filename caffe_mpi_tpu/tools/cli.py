@@ -205,17 +205,22 @@ def cmd_train(args) -> int:
 
     t0 = time.time()
     start_iter = solver.iter
-    while solver.iter < sp.max_iter and not state["stop"]:
-        chunk = min(100, sp.max_iter - solver.iter)
-        solver.step(chunk, feed_fn, test_feed_fns)
-        if state["snap"]:
-            state["snap"] = False
-            solver.snapshot()
-    if (state["stop"] and args.sigint_effect == "stop") or (
-            not state["stop"] and sp.snapshot_prefix
-            and solver.should_snapshot_after_train()):
-        solver.snapshot()  # reference snapshots at stop/after-train
-        # (solver.cpp:402-407)
+    try:
+        while solver.iter < sp.max_iter and not state["stop"]:
+            chunk = min(100, sp.max_iter - solver.iter)
+            solver.step(chunk, feed_fn, test_feed_fns)
+            if state["snap"]:
+                state["snap"] = False
+                solver.snapshot()
+        if (state["stop"] and args.sigint_effect == "stop") or (
+                not state["stop"] and sp.snapshot_prefix
+                and solver.should_snapshot_after_train()):
+            solver.snapshot()  # reference snapshots at stop/after-train
+            # (solver.cpp:402-407)
+    finally:
+        # async interval writes must land even when training raises —
+        # a half-written checkpoint is worse than a slow exit
+        solver.wait_snapshots()
     elapsed = time.time() - t0
     imgs = (solver.iter - start_iter) * solver._batch_images() * max(sp.iter_size, 1)
     log.info("Optimization done: %d iters, %.1f s, %.1f img/s overall",

@@ -3,6 +3,8 @@ closed-form update checks on a least-squares net, snapshot/restore
 round-trip, LR policies, and an end-to-end LeNet-style convergence run.
 """
 
+import os
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -230,6 +232,58 @@ class TestEndToEnd:
         solver2.step(3, lambda it: data[it % 4])
         np.testing.assert_allclose(np.array(solver2.params["ip"]["weight"]),
                                    w_after, rtol=1e-5)
+
+    def test_async_snapshot_is_point_in_time(self, rng, tmp_path):
+        """snapshot(block=False) must capture the state at the trigger
+        iteration even while training races ahead — jax arrays are
+        immutable, so the captured pytree IS that instant's state."""
+        data = [lsq_feeds(rng) for _ in range(4)]
+
+        ref = make_solver('type: "Adam" momentum: 0.9')
+        ref.sp.snapshot_prefix = str(tmp_path / "ref")
+        ref.step(2, lambda it: data[it % 4])
+        ref_path = ref.snapshot()  # blocking, at iter 2
+
+        solver = make_solver('type: "Adam" momentum: 0.9 snapshot: 2')
+        solver.sp.snapshot_prefix = str(tmp_path / "async")
+        # interval snapshots fire async inside step(); training continues
+        solver.step(6, lambda it: data[it % 4])
+        solver.wait_snapshots()
+        for it in (2, 4, 6):
+            assert os.path.exists(tmp_path / f"async_iter_{it}.solverstate")
+
+        # the async iter-2 snapshot equals a blocking snapshot taken by an
+        # identical solver stopped at iter 2 — byte for byte
+        ref_bytes = (tmp_path / "ref_iter_2.caffemodel").read_bytes()
+        async_bytes = (tmp_path / "async_iter_2.caffemodel").read_bytes()
+        assert ref_bytes == async_bytes
+        s1 = (tmp_path / "ref_iter_2.solverstate").read_bytes()
+        s2 = (tmp_path / "async_iter_2.solverstate").read_bytes()
+        # the embedded learned_net filename differs (prefix); compare by
+        # restoring both and checking identical continued training
+        a = make_solver('type: "Adam" momentum: 0.9')
+        a.restore(str(tmp_path / "async_iter_2.solverstate"))
+        b = make_solver('type: "Adam" momentum: 0.9')
+        b.restore(ref_path)
+        assert a.iter == b.iter == 2
+        a.step(3, lambda it: data[it % 4])
+        b.step(3, lambda it: data[it % 4])
+        np.testing.assert_allclose(np.array(a.params["ip"]["weight"]),
+                                   np.array(b.params["ip"]["weight"]),
+                                   rtol=1e-6)
+        assert len(s1) and len(s2)
+
+    def test_async_snapshot_failure_is_raised(self, rng, tmp_path):
+        """A failed background write must surface, not exit 0 with the
+        user believing checkpoints exist."""
+        target = tmp_path / "blocked"
+        target.write_text("a file, not a directory")
+        solver = make_solver('type: "SGD" momentum: 0.9 snapshot: 2')
+        solver.sp.snapshot_prefix = str(target / "s")  # mkdir will fail
+        data = [lsq_feeds(rng) for _ in range(4)]
+        with pytest.raises(RuntimeError, match="async snapshot failed"):
+            solver.step(2, lambda it: data[it % 4])
+            solver.wait_snapshots()
 
     def test_solverstate_is_reference_binaryproto(self, rng, tmp_path):
         """The .solverstate on disk is the reference's SolverState wire

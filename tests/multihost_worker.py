@@ -44,14 +44,22 @@ from test_multihost import (  # noqa: E402
 def main():
     coordinator, num_procs, proc_id, out_path = (
         sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+    mode = sys.argv[5] if len(sys.argv) > 5 else "dp"
     init_distributed(coordinator, num_procs, proc_id)
     assert jax.process_count() == num_procs, jax.process_count()
     assert len(jax.devices()) == 2 * num_procs, len(jax.devices())
 
     plan = MeshPlan.data_parallel()
-    sp = SolverParameter.from_text(SOLVER_TEXT)
+    text = SOLVER_TEXT + (" zero_stage: 1" if mode == "zero" else "")
+    sp = SolverParameter.from_text(text)
     sp.net_param = NetParameter.from_text(NET)
     solver = Solver(sp, mesh=plan, rank=proc_id)
+    if mode == "zero":
+        # slots of dim-0-divisible params really live split over 'data'
+        # spanning BOTH processes (the multi-host ZeRO case)
+        (hist,) = solver.opt_state["ip1"]["weight"]
+        assert hist.sharding.spec[0] == "data", hist.sharding.spec
+        assert not hist.is_fully_addressable  # remote shards exist
 
     data = global_batches(N_STEPS)
     local = GLOBAL_BATCH // num_procs
@@ -64,6 +72,14 @@ def main():
         return {"x": jnp.asarray(b["x"][sl]), "t": jnp.asarray(b["t"][sl])}
 
     solver.step(N_STEPS, feed)
+    if mode == "zero":
+        # snapshot with remote-sharded slots: the history gather is a
+        # COLLECTIVE process_allgather, so every rank enters snapshot();
+        # async falls back to blocking (collective order must stay
+        # rank-identical); only rank 0 writes the two files
+        solver.sp.snapshot_prefix = out_path + ".snap"
+        solver.snapshot(block=False)
+        solver.wait_snapshots()
     if proc_id == 0:
         # params are replicated, so process 0's local replica is the
         # global value

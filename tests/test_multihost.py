@@ -50,8 +50,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_dp_matches_single_process(tmp_path):
+def _run_cluster(tmp_path, mode):
     port = _free_port()
     out = tmp_path / "proc0_params.npz"
     # children set their own platform pins; don't let the suite's leak in
@@ -60,7 +59,7 @@ def test_two_process_dp_matches_single_process(tmp_path):
     procs = [
         subprocess.Popen(
             [sys.executable, os.path.join(_HERE, "multihost_worker.py"),
-             f"localhost:{port}", "2", str(i), str(out)],
+             f"localhost:{port}", "2", str(i), str(out), mode],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True)
         for i in range(2)
@@ -76,9 +75,10 @@ def test_two_process_dp_matches_single_process(tmp_path):
         logs.append(stdout)
     for i, (p, l) in enumerate(zip(procs, logs)):
         assert p.returncode == 0, f"proc {i} failed:\n{l[-3000:]}"
-    got = np.load(out)
+    return out
 
-    # single-process reference on the same global batches, in-suite
+
+def _single_process_reference():
     import jax.numpy as jnp
     from caffe_mpi_tpu.proto import NetParameter, SolverParameter
     from caffe_mpi_tpu.solver import Solver
@@ -89,7 +89,40 @@ def test_two_process_dp_matches_single_process(tmp_path):
     data = global_batches(N_STEPS)
     solver.step(N_STEPS, lambda it: {
         "x": jnp.asarray(data[it]["x"]), "t": jnp.asarray(data[it]["t"])})
+    return solver
 
+
+@pytest.mark.slow
+def test_two_process_zero1_with_collective_snapshot(tmp_path):
+    """Multi-host ZeRO-1: slots span both processes; training matches
+    single-process; snapshot's history gather runs the collective
+    process_allgather path and rank 0's files parse + match."""
+    out = _run_cluster(tmp_path, "zero")
+    got = np.load(out)
+    ref = _single_process_reference()
+    np.testing.assert_allclose(got["ip1_w"],
+                               np.asarray(ref.params["ip1"]["weight"]),
+                               rtol=2e-4, atol=1e-6)
+    from caffe_mpi_tpu.io import load_solverstate
+    state = str(out) + f".snap_iter_{N_STEPS}.solverstate"
+    assert os.path.exists(state)
+    it, _learned, history, _cur = load_solverstate(state)
+    assert it == N_STEPS
+    assert len(history) == 4  # (w,b) x 2 layers, 1 SGD slot each
+    # the allgathered ip1 weight history equals the single-process slot
+    (ref_hist,) = ref.opt_state["ip1"]["weight"]
+    ref_hist = np.asarray(ref_hist)
+    np.testing.assert_allclose(history[0].reshape(ref_hist.shape), ref_hist,
+                               rtol=2e-4, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_two_process_dp_matches_single_process(tmp_path):
+    out = _run_cluster(tmp_path, "dp")
+    got = np.load(out)
+
+    # single-process reference on the same global batches, in-suite
+    solver = _single_process_reference()
     np.testing.assert_allclose(got["ip1_w"],
                                np.asarray(solver.params["ip1"]["weight"]),
                                rtol=2e-4, atol=1e-6)

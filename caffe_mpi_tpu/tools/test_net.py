@@ -1,0 +1,14 @@
+"""Deprecated shim (reference tools/test_net.cpp:3-8 — an equally-thin
+LOG(FATAL) redirect): use the caffe CLI subcommand instead."""
+
+import sys
+
+
+def main(argv=None) -> int:
+    print("test_net is deprecated. Use: python -m caffe_mpi_tpu.tools.cli "
+          "test ...", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

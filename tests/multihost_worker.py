@@ -1,6 +1,11 @@
-"""Subprocess target for the 2-process multi-host DP test.
+"""Subprocess target for the 2-process multi-host tests (DP and ZeRO-1).
 
-Run as: python multihost_worker.py <coordinator> <num_procs> <proc_id> <out.npz>
+Run as: python multihost_worker.py <coordinator> <num_procs> <proc_id> \
+            <out.npz> [dp|zero]
+
+mode "zero" (default "dp") trains with zero_stage: 1 — optimizer slots
+sharded across BOTH processes — and takes a snapshot whose history
+gather runs the collective process_allgather path.
 
 Each process is one "host" of a jax.distributed cluster on localhost
 (CPU backend, 2 local devices each -> 4 global). The process feeds only

@@ -55,10 +55,14 @@ def main() -> int:
         return 0
     quick = "--quick" in sys.argv
     py = sys.executable
-    # line-buffered: a SIGTERM'd run (timeout/Ctrl-C) keeps every entry
-    # written so far — partial hardware evidence is the valuable kind
-    with open(os.path.join(_ROOT, "tpu_validation.log"), "w",
-              buffering=1) as log:
+    # Write incrementally to a .partial file (line-buffered, so an
+    # interrupted run keeps its entries) and only REPLACE the real log on
+    # completion — an aborted/contended run must never clobber committed
+    # hardware evidence (that happened once: a killed run truncated the
+    # log to 0 bytes and the empty file got committed).
+    final = os.path.join(_ROOT, "tpu_validation.log")
+    partial = final + ".partial"
+    with open(partial, "w", buffering=1) as log:
         log.write(f"TPU validation @ {time.ctime()}\n")
         probe_ok = run(
             "probe",
@@ -69,7 +73,8 @@ def main() -> int:
             120, log)
         if not probe_ok:
             log.write("tunnel down; aborting\n")
-            print("tunnel down; aborting")
+            print("tunnel down; aborting (partial log kept at "
+                  f"{partial}; {final} untouched)")
             return 1
         run("bench", [py, "bench.py"], 600, log)
         # NOT via pytest: tests/conftest.py pins the CPU platform; the
@@ -110,6 +115,7 @@ for causal in (False, True):
                  "-solver", "models/lenet/lenet_solver.prototxt",
                  "-synthetic", "-max_iter", "200", "-gpu", "all"],
                 600, log)
+    os.replace(partial, final)
     print("summary written to tpu_validation.log")
     return 0
 

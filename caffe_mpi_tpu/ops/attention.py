@@ -54,8 +54,9 @@ def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
 
     use_flash: route through the Pallas flash-attention kernels
     (ops/flash_attention.py) — O(S) memory VMEM-tiled online softmax,
-    differentiable (custom_vjp backward kernels); sequence lengths must
-    tile evenly. flash_interpret: None picks interpreter mode when the
+    differentiable (custom_vjp backward kernels); arbitrary sequence
+    lengths (uneven lengths are padded to the kernel tile sizes and
+    masked). flash_interpret: None picks interpreter mode when the
     process default backend is not TPU; pass an explicit bool when
     executing somewhere other than the default backend (e.g. CPU-pinned
     under a TPU-default process)."""

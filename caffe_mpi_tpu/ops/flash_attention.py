@@ -37,7 +37,7 @@ BK = 128  # key tile
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, sk,
-                bq, bk):
+                bq, bk, sk_valid):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)  # (bq, d)
     n_k = sk // bk
@@ -48,15 +48,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, sk,
         v = v_ref[0, pl.dslice(j * bk, bk), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
+        mask = None
+        if causal or sk_valid < sk:
             rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(rows >= cols, s, -jnp.inf)
+            # causal: only keys at/before the query; padded key columns
+            # (cols >= sk_valid, zero-filled by the wrapper) must not
+            # contribute to the softmax DENOMINATOR (exp(0-m) != 0)
+            mask = rows >= cols if causal else cols < sk_valid
+            if causal and sk_valid < sk:
+                mask &= cols < sk_valid
+            s = jnp.where(mask, s, -jnp.inf)
         blk_m = jnp.max(s, axis=1)
         blk_m = jnp.where(jnp.isneginf(blk_m), 0.0, blk_m)
         p = jnp.exp(s - blk_m[:, None])
-        if causal:
-            p = jnp.where(rows >= cols, p, 0.0)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
         blk_l = jnp.sum(p, axis=1)
         new_m = jnp.maximum(m, blk_m)
         alpha = jnp.exp(m - new_m)
@@ -88,7 +95,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, sk,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, scale, causal, sk, bq, bk):
+                   *, scale, causal, sk, bq, bk, sk_valid):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
@@ -102,10 +109,17 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse[:, None])          # normalized probabilities
-        if causal:
+        # padded key columns must be zeroed HERE too, not only in the
+        # forward: p = exp(0 - lse) overflows to inf when a row's valid
+        # scores are all strongly negative (lse < -88), and inf * k_pad
+        # would turn dQ into NaN via inf*0
+        if causal or sk_valid < sk:
             rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            p = jnp.where(rows >= cols, p, 0.0)
+            mask = rows >= cols if causal else cols < sk_valid
+            if causal and sk_valid < sk:
+                mask &= cols < sk_valid
+            p = jnp.where(mask, p, 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
@@ -172,14 +186,22 @@ def _check_tiles(sq: int, sk: int) -> tuple[int, int]:
     return bq, bk
 
 
-def _fwd_impl(q, k, v, causal, interpret):
+def _pad_len(s: int, tile: int) -> int:
+    """Padded length: a single short tile is legal as-is (block dims equal
+    to array dims satisfy Mosaic's tiling rule); longer sequences round up
+    to a tile multiple."""
+    return s if s <= tile else -(-s // tile) * tile
+
+
+def _fwd_impl(q, k, v, causal, interpret, sk_valid=None):
     """(B*H, S, D) inputs -> (out, lse)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq, bk = _check_tiles(sq, sk)
     scale = 1.0 / math.sqrt(d)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               sk=sk, bq=bq, bk=bk)
+                               sk=sk, bq=bq, bk=bk,
+                               sk_valid=sk if sk_valid is None else sk_valid)
     return pl.pallas_call(
         kernel,
         grid=(bh, sq // bq),
@@ -200,7 +222,7 @@ def _fwd_impl(q, k, v, causal, interpret):
     )(q, k, v)
 
 
-def _bwd_impl(q, k, v, out, lse, do, causal, interpret):
+def _bwd_impl(q, k, v, out, lse, do, causal, interpret, sk_valid=None):
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq, bk = _check_tiles(sq, sk)
@@ -211,7 +233,8 @@ def _bwd_impl(q, k, v, out, lse, do, causal, interpret):
                     axis=-1)[:, None, :]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          sk=sk, bq=bq, bk=bk),
+                          sk=sk, bq=bq, bk=bk,
+                          sk_valid=sk if sk_valid is None else sk_valid),
         grid=(bh, sq // bq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),   # q
@@ -250,20 +273,25 @@ def _bwd_impl(q, k, v, out, lse, do, causal, interpret):
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash(q, k, v, causal, interpret):
-    out, _ = _fwd_impl(q, k, v, causal, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, interpret, sk_valid):
+    out, _ = _fwd_impl(q, k, v, causal, interpret, sk_valid)
     return out
 
 
-def _flash_fwd(q, k, v, causal, interpret):
-    out, lse = _fwd_impl(q, k, v, causal, interpret)
+def _flash_fwd(q, k, v, causal, interpret, sk_valid):
+    out, lse = _fwd_impl(q, k, v, causal, interpret, sk_valid)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, interpret, res, do):
+def _flash_bwd(causal, interpret, sk_valid, res, do):
+    # sk_valid reaches the dQ kernel (p at padded columns can overflow to
+    # inf when lse < -88 and must be zeroed before ds @ k). The dK/dV
+    # kernel needs no mask: padded Q rows carry do = 0 (the output
+    # slice's cotangent) and padded K/V ROW garbage lands only in output
+    # rows the wrapper slices off.
     q, k, v, out, lse = res
-    return _bwd_impl(q, k, v, out, lse, do, causal, interpret)
+    return _bwd_impl(q, k, v, out, lse, do, causal, interpret, sk_valid)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -273,12 +301,24 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                     causal: bool = False, interpret: bool = False
                     ) -> jnp.ndarray:
     """q,k,v: (B, S, H, D) -> (B, S, H, D). Differentiable: jax.grad hits
-    the Pallas backward kernels via custom_vjp."""
+    the Pallas backward kernels via custom_vjp.
+
+    Arbitrary sequence lengths: lengths that don't tile evenly are padded
+    up to the (128, 128) q/k tile sizes — padded key columns are masked
+    out of the in-kernel softmax, padded query rows are sliced off the
+    output (their gradients vanish through the zero cotangent)."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    _check_tiles(sq, sk)
-    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    out = _flash(qt, kt, vt, causal, interpret)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    sq_p, sk_p = _pad_len(sq, BQ), _pad_len(sk, BK)
+    if sq_p != sq:
+        q = jnp.pad(q, ((0, 0), (0, sq_p - sq), (0, 0), (0, 0)))
+    if sk_p != sk:
+        k = jnp.pad(k, ((0, 0), (0, sk_p - sk), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, sk_p - sk), (0, 0), (0, 0)))
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq_p, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk_p, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk_p, d)
+    out = _flash(qt, kt, vt, causal, interpret,
+                 sk if sk_p != sk else None)
+    out = out.reshape(b, h, sq_p, d).transpose(0, 2, 1, 3)
+    return out[:, :sq] if sq_p != sq else out

@@ -51,11 +51,52 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.array(out), np.array(ref), rtol=2e-5,
                                    atol=1e-6)
 
-    def test_rejects_ragged_sequences(self, rng):
+    @pytest.mark.parametrize("sq,sk", [(130, 130), (300, 160), (100, 333),
+                                       (257, 257)])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_uneven_lengths_match_reference(self, rng, sq, sk, causal):
+        """Lengths that don't tile evenly are padded+masked in-kernel:
+        padded key columns must not leak into the softmax denominator and
+        padded query rows must not leak into dK/dV."""
         from caffe_mpi_tpu.ops.flash_attention import flash_attention
-        q, k, v = qkv(rng, s=130)
-        with pytest.raises(ValueError, match="multiples"):
-            flash_attention(q, k, v)
+        q, _, _ = qkv(rng, b=1, s=sq, h=2, d=32)
+        _, k, v = qkv(rng, b=1, s=sk, h=2, d=32)
+        ref = attention(q, k, v, causal=causal)
+        out = flash_attention(q, k, v, causal=causal, interpret=True)
+        np.testing.assert_allclose(np.array(out), np.array(ref), rtol=2e-5,
+                                   atol=1e-6)
+
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, causal=causal, interpret=True)
+            return jnp.sum(jnp.sin(o))
+
+        def loss_ref(q, k, v):
+            return jnp.sum(jnp.sin(attention(q, k, v, causal=causal)))
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, gr, "qkv"):
+            np.testing.assert_allclose(np.array(a), np.array(b), rtol=2e-4,
+                                       atol=2e-5, err_msg=f"d{name}")
+
+    def test_uneven_lengths_extreme_logits_no_nan(self, rng):
+        """With padded keys and all-strongly-negative valid scores
+        (row lse < -88), the recomputed p at padded columns is
+        exp(0 - lse) -> inf; unmasked it would NaN dQ via inf*0."""
+        from caffe_mpi_tpu.ops.flash_attention import flash_attention
+        q, _, _ = qkv(rng, b=1, s=160, h=1, d=32)
+        _, k, v = qkv(rng, b=1, s=160, h=1, d=32)
+        # drive every valid score strongly negative (row lse ~ -100,
+        # past the exp(-lse) f32 overflow threshold of ~88.7) while
+        # keeping softmax comparisons meaningful
+        q = jnp.abs(q) * 6.0
+        k = -jnp.abs(k) * 6.0
+        g = jax.grad(lambda q: jnp.sum(flash_attention(
+            q, k, v, interpret=True)))(q)
+        assert np.isfinite(np.array(g)).all()
+        gr = jax.grad(lambda q: jnp.sum(attention(q, k, v)))(q)
+        np.testing.assert_allclose(np.array(g), np.array(gr), rtol=2e-4,
+                                   atol=2e-5)
 
     @pytest.mark.parametrize("causal", [False, True])
     def test_backward_matches_reference(self, rng, causal):

@@ -17,10 +17,22 @@ Output dim: floor((H + 2p - ((k-1)*dilation + 1)) / s) + 1 — conv uses floor
 
 from __future__ import annotations
 
+import os
+
 import jax.numpy as jnp
 from jax import lax
 
 DN = lax.conv_dimension_numbers
+
+# Layout experiment knob (hardware A/B): CAFFE_CONV_LAYOUT=NHWC routes
+# every conv through NHWC/HWIO dimension numbers with transposes at the
+# op edges. The logical blob layout stays NCHW everywhere (Caffe
+# semantics are NCHW-shaped); XLA cancels the back-to-back transposes
+# between consecutive conv/elementwise ops, so this approximates a true
+# NHWC pipeline closely enough to measure whether XLA's TPU layout
+# assignment already saturates the MXU from NCHW graphs (docs/benchmarks
+# records the measurement). Default: NCHW, trusting layout assignment.
+_NHWC = os.environ.get("CAFFE_CONV_LAYOUT", "").upper() == "NHWC"
 
 
 def conv_output_dim(size: int, kernel: int, pad: int, stride: int, dilation: int) -> int:
@@ -32,6 +44,20 @@ def conv2d(x: jnp.ndarray, w: jnp.ndarray, stride: tuple[int, int],
            pad: tuple[int, int], dilation: tuple[int, int] = (1, 1),
            groups: int = 1, precision: str | None = None) -> jnp.ndarray:
     """x: (N, Cin, H, W); w: (Cout, Cin/groups, kh, kw) -> (N, Cout, oh, ow)."""
+    if _NHWC:
+        xt = x.transpose(0, 2, 3, 1)
+        wt = w.transpose(2, 3, 1, 0)  # OIHW -> HWIO
+        dn = DN(xt.shape, wt.shape, ("NHWC", "HWIO", "NHWC"))
+        out = lax.conv_general_dilated(
+            xt, wt,
+            window_strides=stride,
+            padding=((pad[0], pad[0]), (pad[1], pad[1])),
+            rhs_dilation=dilation,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+            precision=precision,
+        )
+        return out.transpose(0, 3, 1, 2)
     dn = DN(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
     return lax.conv_general_dilated(
         x, w,

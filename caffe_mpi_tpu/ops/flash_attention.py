@@ -36,11 +36,38 @@ BQ = 128  # query tile (MXU-aligned)
 BK = 128  # key tile
 
 
+def _tile_mask(qi, j, bq, bk, causal, sk, sk_valid):
+    """Valid-score mask for the (qi, j) q x k tile, or None when every
+    entry is valid. ONE definition shared by the forward and dQ kernels —
+    a mask change applied to only one of them would silently desync
+    gradients from the forward. causal: keys at/before the query only;
+    sk_valid < sk: padded key columns (zero-filled by the wrapper) must
+    not contribute (exp(0-m) != 0 in the softmax denominator; in dQ,
+    p = exp(0 - lse) can overflow to inf)."""
+    if not causal and sk_valid >= sk:
+        return None
+    rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    mask = None
+    if causal:
+        mask = rows >= cols
+    if sk_valid < sk:
+        ok = cols < sk_valid
+        mask = ok if mask is None else mask & ok
+    return mask
+
+
+def _n_k_tiles(sk, bk, sk_valid):
+    """Key tiles worth visiting: fully-padded tiles are 100% masked —
+    skipping them is free accuracy-wise."""
+    return -(-sk_valid // bk) if sk_valid < sk else sk // bk
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, sk,
                 bq, bk, sk_valid):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)  # (bq, d)
-    n_k = sk // bk
+    n_k = _n_k_tiles(sk, bk, sk_valid)
 
     def body(j, carry):
         out, m, l = carry
@@ -48,16 +75,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, sk,
         v = v_ref[0, pl.dslice(j * bk, bk), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        mask = None
-        if causal or sk_valid < sk:
-            rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            # causal: only keys at/before the query; padded key columns
-            # (cols >= sk_valid, zero-filled by the wrapper) must not
-            # contribute to the softmax DENOMINATOR (exp(0-m) != 0)
-            mask = rows >= cols if causal else cols < sk_valid
-            if causal and sk_valid < sk:
-                mask &= cols < sk_valid
+        mask = _tile_mask(qi, j, bq, bk, causal, sk, sk_valid)
+        if mask is not None:
             s = jnp.where(mask, s, -jnp.inf)
         blk_m = jnp.max(s, axis=1)
         blk_m = jnp.where(jnp.isneginf(blk_m), 0.0, blk_m)
@@ -79,8 +98,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, sk,
     m0 = jnp.full((bq,), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((bq,), jnp.float32)
     if causal:
-        # only K tiles at or before this Q tile can contribute
-        n_iter = jnp.minimum((qi + 1) * bq + bk - 1, sk) // bk
+        # only K tiles at or before this Q tile can contribute (and never
+        # the fully-padded trailing tiles)
+        n_iter = jnp.minimum(
+            jnp.minimum((qi + 1) * bq + bk - 1, sk) // bk, n_k)
     else:
         n_iter = n_k
     out, m, l = jax.lax.fori_loop(0, n_iter, body, (out0, m0, l0))
@@ -101,7 +122,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0, 0].astype(jnp.float32)       # (bq,)
     delta = delta_ref[0, 0].astype(jnp.float32)   # (bq,)
-    n_k = sk // bk
+    n_k = _n_k_tiles(sk, bk, sk_valid)
 
     def body(j, dq):
         k = k_ref[0, pl.dslice(j * bk, bk), :].astype(jnp.float32)
@@ -109,16 +130,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse[:, None])          # normalized probabilities
-        # padded key columns must be zeroed HERE too, not only in the
-        # forward: p = exp(0 - lse) overflows to inf when a row's valid
-        # scores are all strongly negative (lse < -88), and inf * k_pad
-        # would turn dQ into NaN via inf*0
-        if causal or sk_valid < sk:
-            rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            mask = rows >= cols if causal else cols < sk_valid
-            if causal and sk_valid < sk:
-                mask &= cols < sk_valid
+        # the same mask as the forward (see _tile_mask: padded-column p
+        # here can overflow to inf and NaN dQ via inf*0)
+        mask = _tile_mask(qi, j, bq, bk, causal, sk, sk_valid)
+        if mask is not None:
             p = jnp.where(mask, p, 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -129,7 +144,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     d = q_ref.shape[-1]
     if causal:
-        n_iter = jnp.minimum((qi + 1) * bq + bk - 1, sk) // bk
+        n_iter = jnp.minimum(
+            jnp.minimum((qi + 1) * bq + bk - 1, sk) // bk, n_k)
     else:
         n_iter = n_k
     dq = jax.lax.fori_loop(0, n_iter, body, jnp.zeros((bq, d), jnp.float32))

@@ -100,11 +100,13 @@ class AttentionLayer(Layer):
                 and mp.mesh.shape.get("model", 1) > 1):
             # prototxt-declared SP: the sequence dim shards over 'model'
             # and K/V ride the ICI ring (ops/attention.py ring_attention);
-            # the batch dim stays on 'data' so DPxSP composes
+            # the batch dim stays on 'data' so DPxSP composes. use_flash
+            # upgrades the per-block compute to the Pallas kernels
+            # (ring_flash_attention) — O(S/n) memory, no (S/n)^2 scores
             out = sequence_parallel_attention(
                 q, k, v, mp.mesh, seq_axis="model", causal=bool(p.causal),
                 batch_axis="data" if mp.mesh.shape.get("data", 1) > 1
-                else None)
+                else None, use_flash=bool(p.use_flash))
         else:
             out = attention(q, k, v, causal=bool(p.causal),
                             use_flash=bool(p.use_flash))

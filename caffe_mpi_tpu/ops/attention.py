@@ -135,9 +135,171 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     return out / jnp.maximum(l, 1e-30)[..., None].swapaxes(1, 2)
 
 
+# ---------------------------------------------------------------------------
+# Ring FLASH attention: the ring schedule with the Pallas flash kernels as
+# the per-block compute. ring_attention's _block_attn materializes the
+# (S/n, S/n) score matrix per rotation in HBM; here each block runs the
+# VMEM-tiled online softmax instead, so per-device memory stays O(S/n)
+# even for very long local shards. Differentiation is owned by the ring:
+# a custom_vjp whose backward makes the same K/V trip and calls the block
+# backward kernels against the ring-MERGED (out, lse) — each block's
+# recomputed p is then exactly the global probabilities restricted to the
+# block, so summed dq / routed-home dk,dv are the exact global gradients.
+# ---------------------------------------------------------------------------
+
+def _block_bias(src, s_loc, valid_len):
+    """(1, s_loc) f32 additive score bias for the K/V block owned by ring
+    position `src`: 0 for keys inside the global valid length, -inf for
+    the tail padding (which lives in the last shard)."""
+    cols = src * s_loc + jnp.arange(s_loc)
+    return jnp.where(cols < valid_len, 0.0, -jnp.inf).astype(
+        jnp.float32)[None, :]
+
+
+def _merge_blocks(O, LSE, out_b, lse_b):
+    """Online-softmax merge of a new normalized block (out_b, lse_b) into
+    the running (O, LSE). All f32; O (BH,S,D), LSE (BH,1,S)."""
+    M = jnp.maximum(LSE, lse_b)
+    a = jnp.exp(LSE - M)        # 0 at the -inf init
+    bw = jnp.exp(lse_b - M)
+    denom = a + bw
+    row = lambda t: t[:, 0, :, None]        # (BH,1,S) -> (BH,S,1)
+    O_new = (O * row(a) + out_b * row(bw)) / row(denom)
+    return O_new, M + jnp.log(denom)
+
+
+def _ring_rotate(axis_name, *arrays):
+    n = lax.axis_size(axis_name)
+    perm = [(j, (j - 1) % n) for j in range(n)]
+    return tuple(lax.ppermute(a, axis_name, perm) for a in arrays)
+
+
+def _ring_flash_loop(q2, k2, v2, axis_name, causal, valid_len, interpret):
+    from .flash_attention import flash_block
+    from ..parallel.mesh import mark_varying
+
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    bh, s, d = q2.shape
+    O = mark_varying(jnp.zeros((bh, s, d), jnp.float32), like=q2)
+    LSE = mark_varying(jnp.full((bh, 1, s), -jnp.inf, jnp.float32), like=q2)
+    kk, vv = k2, v2
+    for i in range(n):  # n is static under shard_map; unrolled
+        src = (my + i) % n
+
+        def compute(O, LSE, kk, vv, src=src, i=i):
+            bias = (None if valid_len is None
+                    else _block_bias(src, s, valid_len))
+            out_b, lse_b = flash_block(q2, kk, vv, causal=causal and i == 0,
+                                       k_bias=bias, interpret=interpret)
+            return _merge_blocks(O, LSE, out_b.astype(jnp.float32), lse_b)
+
+        if causal and i > 0:
+            # whole block in the future of every local query -> skip;
+            # src < my <=> the block holds strictly-earlier positions
+            O, LSE = lax.cond(src < my, compute,
+                              lambda O, LSE, kk, vv: (O, LSE),
+                              O, LSE, kk, vv)
+        else:
+            O, LSE = compute(O, LSE, kk, vv)
+        if i < n - 1:
+            kk, vv = _ring_rotate(axis_name, kk, vv)
+    return O, LSE
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_flash(q, k, v, axis_name, causal, valid_len, interpret):
+    out, _ = _ring_flash_fwd(q, k, v, axis_name, causal, valid_len,
+                             interpret)
+    return out
+
+
+def _to_heads2(t):
+    b, s, h, d = t.shape
+    return t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _from_heads2(t2, b, h):
+    bh, s, d = t2.shape
+    return t2.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, valid_len, interpret):
+    b, s, h, d = q.shape
+    O, LSE = _ring_flash_loop(_to_heads2(q), _to_heads2(k), _to_heads2(v),
+                              axis_name, causal, valid_len, interpret)
+    out = _from_heads2(O.astype(q.dtype), b, h)
+    return out, (q, k, v, out, LSE)
+
+
+def _ring_flash_bwd(axis_name, causal, valid_len, interpret, res, dout):
+    from .flash_attention import _delta, flash_block_bwd
+    from ..parallel.mesh import mark_varying
+
+    q, k, v, out, LSE = res
+    b, s, h, d = q.shape
+    q2, k2, v2 = _to_heads2(q), _to_heads2(k), _to_heads2(v)
+    out2, do2 = _to_heads2(out), _to_heads2(dout)
+    delta = _delta(do2, out2)   # global rowsum(dO*O), shared by blocks
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+
+    dq = mark_varying(jnp.zeros(q2.shape, jnp.float32), like=q2)
+    dkk = mark_varying(jnp.zeros(k2.shape, jnp.float32), like=q2)
+    dvv = mark_varying(jnp.zeros(v2.shape, jnp.float32), like=q2)
+    kk, vv = k2, v2
+    for i in range(n):
+        src = (my + i) % n
+
+        def compute(dq, dkk, dvv, kk, vv, src=src, i=i):
+            bias = (None if valid_len is None
+                    else _block_bias(src, s, valid_len))
+            dq_i, dk_b, dv_b = flash_block_bwd(
+                q2, kk, vv, out2, LSE, do2, causal=causal and i == 0,
+                k_bias=bias, interpret=interpret, delta=delta)
+            return (dq + dq_i.astype(jnp.float32),
+                    dkk + dk_b.astype(jnp.float32),
+                    dvv + dv_b.astype(jnp.float32))
+
+        if causal and i > 0:
+            dq, dkk, dvv = lax.cond(
+                src < my, compute,
+                lambda dq, dkk, dvv, kk, vv: (dq, dkk, dvv),
+                dq, dkk, dvv, kk, vv)
+        else:
+            dq, dkk, dvv = compute(dq, dkk, dvv, kk, vv)
+        # rotate the K/V blocks AND their gradient accumulators together:
+        # after the full n rotations each dk/dv block is back home at the
+        # device that owns that K/V shard. The final hop moves only the
+        # accumulators — nobody reads kk/vv again.
+        if i < n - 1:
+            kk, vv, dkk, dvv = _ring_rotate(axis_name, kk, vv, dkk, dvv)
+        else:
+            dkk, dvv = _ring_rotate(axis_name, dkk, dvv)
+    return (_from_heads2(dq.astype(q.dtype), b, h),
+            _from_heads2(dkk.astype(k.dtype), b, h),
+            _from_heads2(dvv.astype(v.dtype), b, h))
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ring_flash_attention(q, k, v, *, axis_name: str, causal: bool = False,
+                         valid_len: int | None = None,
+                         interpret: bool = False) -> jnp.ndarray:
+    """ring_attention with flash-kernel blocks: call inside shard_map with
+    the LOCAL (B, S/n, H, D) shards. Differentiable (ring-level
+    custom_vjp). The local shard length must satisfy the flash tiling
+    rule (<= 128 or a multiple of 128) — sequence_parallel_attention's
+    padding guarantees it for ring-size-multiple padded lengths."""
+    return _ring_flash(q, k, v, axis_name, causal, valid_len, interpret)
+
+
 def sequence_parallel_attention(q, k, v, mesh, *, seq_axis: str = "model",
                                 causal: bool = False,
-                                batch_axis: str | None = None):
+                                batch_axis: str | None = None,
+                                use_flash: bool = False,
+                                flash_interpret: bool | None = None):
     """Top-level entry: q,k,v (B,S,H,D) global arrays; shards S over
     `seq_axis` and runs ring attention under shard_map.
 
@@ -147,13 +309,22 @@ def sequence_parallel_attention(q, k, v, mesh, *, seq_axis: str = "model",
 
     batch_axis: optional mesh axis the batch dim is sharded over — pass
     'data' when running inside a DPxSP training step so the shard_map
-    keeps the data-parallel batch split instead of all-gathering it."""
+    keeps the data-parallel batch split instead of all-gathering it.
+
+    use_flash: per-block compute runs the Pallas flash kernels
+    (ring_flash_attention) instead of the jnp online-softmax blocks —
+    per-device memory stays O(S/n) with no (S/n)^2 score materialization.
+    Padding then rounds the LOCAL shard up to the flash tile rule."""
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
     n = mesh.shape[seq_axis]
     s = q.shape[1]
-    pad = (-s) % n
+    if use_flash and -(-s // n) > 128:
+        # local shards > one tile must be 128-multiples (Mosaic tiling)
+        pad = (-s) % (n * 128)
+    else:
+        pad = (-s) % n
     valid_len = s if pad else None
     if pad:
         widths = ((0, 0), (0, pad), (0, 0), (0, 0))
@@ -162,10 +333,21 @@ def sequence_parallel_attention(q, k, v, mesh, *, seq_axis: str = "model",
         v = jnp.pad(v, widths)
 
     spec = P(batch_axis, seq_axis, None, None)
-    fn = shard_map(
-        functools.partial(ring_attention, axis_name=seq_axis, causal=causal,
-                          valid_len=valid_len),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-    )
+    if use_flash:
+        if flash_interpret is None:
+            flash_interpret = jax.default_backend() != "tpu"
+        inner = functools.partial(ring_flash_attention, axis_name=seq_axis,
+                                  causal=causal, valid_len=valid_len,
+                                  interpret=flash_interpret)
+        # check_vma=False: pallas_call's internal slicing mixes varying
+        # and unvarying operands in ways the vma checker rejects (the
+        # jnp ring path below keeps full checking)
+        fn = shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    else:
+        inner = functools.partial(ring_attention, axis_name=seq_axis,
+                                  causal=causal, valid_len=valid_len)
+        fn = shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
     out = fn(q, k, v)
     return out[:, :s] if pad else out

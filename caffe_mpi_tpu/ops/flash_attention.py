@@ -63,8 +63,14 @@ def _n_k_tiles(sk, bk, sk_valid):
     return -(-sk_valid // bk) if sk_valid < sk else sk // bk
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, sk,
-                bq, bk, sk_valid):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, sk,
+                bq, bk, sk_valid, has_bias):
+    """rest = ([bias_ref,] o_ref, lse_ref). bias (1, sk) f32 adds to every
+    score row — 0 for live keys, -inf for masked ones (ring attention
+    uses it to mask globally-padded key positions per rotating block);
+    -inf flows through the existing clamp math: s=-inf -> p=0 exactly,
+    even in fully-biased-out tiles (blk_m clamps to 0 first)."""
+    bias_ref, o_ref, lse_ref = rest if has_bias else (None, *rest)
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)  # (bq, d)
     n_k = _n_k_tiles(sk, bk, sk_valid)
@@ -75,6 +81,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, sk,
         v = v_ref[0, pl.dslice(j * bk, bk), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if has_bias:
+            s = s + bias_ref[0, pl.dslice(j * bk, bk)].astype(
+                jnp.float32)[None, :]
         mask = _tile_mask(qi, j, bq, bk, causal, sk, sk_valid)
         if mask is not None:
             s = jnp.where(mask, s, -jnp.inf)
@@ -115,8 +124,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, sk,
     lse_ref[0, 0] = (m + jnp.log(l_safe)).astype(lse_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, scale, causal, sk, bq, bk, sk_valid):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                   scale, causal, sk, bq, bk, sk_valid, has_bias):
+    bias_ref, dq_ref = rest if has_bias else (None, *rest)
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
@@ -129,6 +139,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         v = v_ref[0, pl.dslice(j * bk, bk), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if has_bias:
+            s = s + bias_ref[0, pl.dslice(j * bk, bk)].astype(
+                jnp.float32)[None, :]
         p = jnp.exp(s - lse[:, None])          # normalized probabilities
         # the same mask as the forward (see _tile_mask: padded-column p
         # here can overflow to inf and NaN dQ via inf*0)
@@ -152,8 +165,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, causal, sq, bq, bk):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                    scale, causal, sq, bq, bk, has_bias):
+    bias_ref, dk_ref, dv_ref = rest if has_bias else (None, *rest)
     ki = pl.program_id(1)
     k = k_ref[0].astype(jnp.float32)   # (bk, d)
     v = v_ref[0].astype(jnp.float32)
@@ -167,6 +181,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0, pl.dslice(i * bq, bq)].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if has_bias:
+            # this kernel's k block is the grid's second axis: the bias
+            # slice is the ki-th tile, broadcast over q rows; -inf makes
+            # p exactly 0, so masked keys get zero dK/dV
+            s = s + bias_ref[0].astype(jnp.float32)[None, :]
         p = jnp.exp(s - lse[:, None])          # (bq, bk)
         if causal:
             rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
@@ -209,84 +228,149 @@ def _pad_len(s: int, tile: int) -> int:
     return s if s <= tile else -(-s // tile) * tile
 
 
-def _fwd_impl(q, k, v, causal, interpret, sk_valid=None):
-    """(B*H, S, D) inputs -> (out, lse)."""
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct for a pallas_call output, carrying the varying-
+    axis set of `like` — under shard_map (ring attention) outputs must
+    declare how they vary over mesh axes; outside it the vma set is
+    empty/absent and a plain struct is produced."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _fwd_impl(q, k, v, causal, interpret, sk_valid=None, k_bias=None):
+    """(B*H, S, D) inputs -> (out, lse). k_bias: optional (1, Sk) f32
+    additive score bias shared by every row/head (0 live, -inf masked)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq, bk = _check_tiles(sq, sk)
     scale = 1.0 / math.sqrt(d)
+    has_bias = k_bias is not None
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                sk=sk, bq=bq, bk=bk,
-                               sk_valid=sk if sk_valid is None else sk_valid)
+                               sk_valid=sk if sk_valid is None else sk_valid,
+                               has_bias=has_bias)
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+    ]
+    args = [q, k, v]
+    if has_bias:
+        in_specs.append(pl.BlockSpec((1, sk), lambda i, j: (0, 0)))
+        args.append(k_bias)
     return pl.pallas_call(
         kernel,
         grid=(bh, sq // bq),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, 1, bq), lambda i, j: (i, 0, j)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
+            _sds((bh, sq, d), q.dtype, q),
+            _sds((bh, 1, sq), jnp.float32, q),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
 
 
-def _bwd_impl(q, k, v, out, lse, do, causal, interpret, sk_valid=None):
+def _delta(do, out):
+    """D_i = rowsum(dO * O) — cheap elementwise+reduce; XLA fuses it.
+    (BH, 1, S) layout for the same Mosaic tiling reason as lse."""
+    return jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                   axis=-1)[:, None, :]
+
+
+def _bwd_impl(q, k, v, out, lse, do, causal, interpret, sk_valid=None,
+              k_bias=None, delta=None):
+    """out/lse are the GLOBAL attention output/logsumexp for these q rows
+    (for plain flash that's this call's own forward; for ring attention
+    each per-block call passes the ring-merged values, which makes the
+    recomputed p the global probabilities restricted to the block)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq, bk = _check_tiles(sq, sk)
     scale = 1.0 / math.sqrt(d)
-    # D_i = rowsum(dO * O) — cheap elementwise+reduce; XLA fuses it.
-    # (BH, 1, S) layout for the same Mosaic tiling reason as lse.
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)[:, None, :]
+    if delta is None:
+        delta = _delta(do, out)
+    has_bias = k_bias is not None
+    dq_specs = [
+        pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),   # q
+        pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # k
+        pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # v
+        pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),   # do
+        pl.BlockSpec((1, 1, bq), lambda i, j: (i, 0, j)),   # lse
+        pl.BlockSpec((1, 1, bq), lambda i, j: (i, 0, j)),   # delta
+    ]
+    dq_args = [q, k, v, do, lse, delta]
+    if has_bias:
+        dq_specs.append(pl.BlockSpec((1, sk), lambda i, j: (0, 0)))
+        dq_args.append(k_bias)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           sk=sk, bq=bq, bk=bk,
-                          sk_valid=sk if sk_valid is None else sk_valid),
+                          sk_valid=sk if sk_valid is None else sk_valid,
+                          has_bias=has_bias),
         grid=(bh, sq // bq),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),   # q
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # k
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # v
-            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),   # do
-            pl.BlockSpec((1, 1, bq), lambda i, j: (i, 0, j)),   # lse
-            pl.BlockSpec((1, 1, bq), lambda i, j: (i, 0, j)),   # delta
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        out_shape=_sds((bh, sq, d), q.dtype, q),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dq_args)
+    dkv_specs = [
+        pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),   # q
+        pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),   # k
+        pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),   # v
+        pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),   # do
+        pl.BlockSpec((1, 1, sq), lambda i, j: (i, 0, 0)),   # lse
+        pl.BlockSpec((1, 1, sq), lambda i, j: (i, 0, 0)),   # delta
+    ]
+    dkv_args = [q, k, v, do, lse, delta]
+    if has_bias:
+        dkv_specs.append(pl.BlockSpec((1, bk), lambda i, j: (0, j)))
+        dkv_args.append(k_bias)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          sq=sq, bq=bq, bk=bk),
+                          sq=sq, bq=bq, bk=bk, has_bias=has_bias),
         grid=(bh, sk // bk),
-        in_specs=[
-            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),   # q
-            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),   # k
-            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),   # v
-            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),   # do
-            pl.BlockSpec((1, 1, sq), lambda i, j: (i, 0, 0)),   # lse
-            pl.BlockSpec((1, 1, sq), lambda i, j: (i, 0, 0)),   # delta
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+            _sds((bh, sk, d), k.dtype, k),
+            _sds((bh, sk, d), v.dtype, v),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dkv_args)
     return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Ring-attention block API (ops/attention.py ring_flash_attention): RAW
+# kernel entries with no custom_vjp — the ring owns differentiation,
+# calling flash_block per K/V rotation and flash_block_bwd with the
+# ring-MERGED (out, lse), which makes each block's recomputed p the
+# global probabilities restricted to that block.
+# ---------------------------------------------------------------------------
+
+def flash_block(q, k, v, *, causal=False, k_bias=None, interpret=False):
+    """(B*H, Sq, D) x (B*H, Sk, D) -> (normalized out, lse). k_bias:
+    (1, Sk) f32, 0 for live keys / -inf for masked (padded) ones."""
+    return _fwd_impl(q, k, v, causal, interpret, k_bias=k_bias)
+
+
+def flash_block_bwd(q, k, v, out, lse, do, *, causal=False, k_bias=None,
+                    interpret=False, delta=None):
+    """Per-block backward against the GLOBAL (out, lse): returns
+    (dq_partial, dk_block, dv_block). Summing dq_partial over blocks and
+    routing each dk/dv block to its owner reconstructs the exact global
+    gradients."""
+    return _bwd_impl(q, k, v, out, lse, do, causal, interpret,
+                     k_bias=k_bias, delta=delta)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))

@@ -130,6 +130,23 @@ class TestSequenceParallelSurface:
         data = sp_batches(2)
         s.step(2, lambda it: data[it % 2])
 
+    def test_sp_flash_matches_single_device(self):
+        """sequence_parallel + use_flash: ring of Pallas flash blocks
+        (interpret mode on CPU) from the prototxt surface — same
+        trajectory as plain single-device attention."""
+        net = SP_NET.replace("sequence_parallel: true",
+                             "sequence_parallel: true use_flash: true")
+        data = sp_batches(6)
+        s_one = make_solver(SP_NET)
+        s_sp = make_solver(net, mesh=MeshPlan.from_shape(data=2, model=4))
+        l1 = s_one.step(3, lambda it: data[it])
+        l2 = s_sp.step(3, lambda it: data[it])
+        assert l1 == pytest.approx(l2, rel=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(s_one.params["attn"]["qkv_weight"]),
+            np.asarray(s_sp.params["attn"]["qkv_weight"]),
+            rtol=2e-4, atol=1e-6)
+
 
 class TestPipelineSurface:
     def test_prototxt_parses_and_roundtrips(self):

@@ -174,6 +174,23 @@ def _ring_rotate(axis_name, *arrays):
     return tuple(lax.ppermute(a, axis_name, perm) for a in arrays)
 
 
+def _block_pred(i, causal, my, src, s_loc, valid_len):
+    """Whether ring step i's K/V block contributes anything, or None for
+    'always'. Two skip reasons share one cond: causal blocks strictly in
+    the future (i>0, src>my), and ENTIRELY-padded shards (src*s_loc >=
+    valid_len). The latter is a correctness requirement, not just a
+    saving: a fully-masked flash block emits lse = log(1e-30) ~ -69 (the
+    l_safe clamp), and merging that phantom term would dominate whenever
+    genuine scores sit below ~ -69."""
+    pred = None
+    if causal and i > 0:
+        pred = src < my
+    if valid_len is not None:
+        live = src * s_loc < valid_len
+        pred = live if pred is None else pred & live
+    return pred
+
+
 def _ring_flash_loop(q2, k2, v2, axis_name, causal, valid_len, interpret):
     from .flash_attention import flash_block
     from ..parallel.mesh import mark_varying
@@ -194,14 +211,13 @@ def _ring_flash_loop(q2, k2, v2, axis_name, causal, valid_len, interpret):
                                        k_bias=bias, interpret=interpret)
             return _merge_blocks(O, LSE, out_b.astype(jnp.float32), lse_b)
 
-        if causal and i > 0:
-            # whole block in the future of every local query -> skip;
-            # src < my <=> the block holds strictly-earlier positions
-            O, LSE = lax.cond(src < my, compute,
+        pred = _block_pred(i, causal, my, src, s, valid_len)
+        if pred is None:
+            O, LSE = compute(O, LSE, kk, vv)
+        else:
+            O, LSE = lax.cond(pred, compute,
                               lambda O, LSE, kk, vv: (O, LSE),
                               O, LSE, kk, vv)
-        else:
-            O, LSE = compute(O, LSE, kk, vv)
         if i < n - 1:
             kk, vv = _ring_rotate(axis_name, kk, vv)
     return O, LSE
@@ -261,13 +277,14 @@ def _ring_flash_bwd(axis_name, causal, valid_len, interpret, res, dout):
                     dkk + dk_b.astype(jnp.float32),
                     dvv + dv_b.astype(jnp.float32))
 
-        if causal and i > 0:
+        pred = _block_pred(i, causal, my, src, s, valid_len)
+        if pred is None:
+            dq, dkk, dvv = compute(dq, dkk, dvv, kk, vv)
+        else:
             dq, dkk, dvv = lax.cond(
-                src < my, compute,
+                pred, compute,
                 lambda dq, dkk, dvv, kk, vv: (dq, dkk, dvv),
                 dq, dkk, dvv, kk, vv)
-        else:
-            dq, dkk, dvv = compute(dq, dkk, dvv, kk, vv)
         # rotate the K/V blocks AND their gradient accumulators together:
         # after the full n rotations each dk/dv block is back home at the
         # device that owns that K/V shard. The final hop moves only the

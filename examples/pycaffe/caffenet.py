@@ -1,0 +1,69 @@
+"""Programmatic CaffeNet authoring with NetSpec (reference
+examples/pycaffe/caffenet.py — same helper idioms: conv_relu, fc_relu,
+max_pool composed into the full topology, then serialized to prototxt).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, _ROOT)
+
+from caffe_mpi_tpu.net_spec import L, NetSpec  # noqa: E402
+
+
+def conv_relu(n, name, bottom, ks, nout, stride=1, pad=0, group=1):
+    conv = L.Convolution(bottom, kernel_size=ks, stride=stride,
+                         num_output=nout, pad=pad, group=group,
+                         weight_filler=dict(type="gaussian", std=0.01))
+    setattr(n, name, conv)
+    setattr(n, "relu_" + name, L.ReLU(conv, in_place=True))
+    return conv
+
+
+def fc_relu(n, name, bottom, nout):
+    fc = L.InnerProduct(bottom, num_output=nout,
+                        weight_filler=dict(type="gaussian", std=0.005))
+    setattr(n, name, fc)
+    setattr(n, "relu_" + name, L.ReLU(fc, in_place=True))
+    return fc
+
+
+def max_pool(bottom, ks, stride=1):
+    return L.Pooling(bottom, pool="MAX", kernel_size=ks, stride=stride)
+
+
+def caffenet(batch_size=256, include_acc=False):
+    """The CaffeNet topology as a prototxt string (Input-fed variant: the
+    zero-egress image has no ImageNet LMDB; swap the Input layer for a
+    Data layer to reproduce the reference's LMDB-fed version)."""
+    n = NetSpec("CaffeNet")
+    n.data, n.label = L.Input(ntop=2, input_param=dict(
+        shape=[dict(dim=[batch_size, 3, 227, 227]),
+               dict(dim=[batch_size])]))
+    conv1 = conv_relu(n, "conv1", n.data, 11, 96, stride=4)
+    n.pool1 = max_pool(conv1, 3, stride=2)
+    n.norm1 = L.LRN(n.pool1, local_size=5, alpha=1e-4, beta=0.75)
+    conv2 = conv_relu(n, "conv2", n.norm1, 5, 256, pad=2, group=2)
+    n.pool2 = max_pool(conv2, 3, stride=2)
+    n.norm2 = L.LRN(n.pool2, local_size=5, alpha=1e-4, beta=0.75)
+    conv3 = conv_relu(n, "conv3", n.norm2, 3, 384, pad=1)
+    conv4 = conv_relu(n, "conv4", conv3, 3, 384, pad=1, group=2)
+    conv5 = conv_relu(n, "conv5", conv4, 3, 256, pad=1, group=2)
+    n.pool5 = max_pool(conv5, 3, stride=2)
+    fc6 = fc_relu(n, "fc6", n.pool5, 4096)
+    n.drop6 = L.Dropout(fc6, in_place=True, dropout_ratio=0.5)
+    fc7 = fc_relu(n, "fc7", n.drop6, 4096)
+    n.drop7 = L.Dropout(fc7, in_place=True, dropout_ratio=0.5)
+    n.fc8 = L.InnerProduct(n.drop7, num_output=1000,
+                           weight_filler=dict(type="gaussian", std=0.01))
+    n.loss = L.SoftmaxWithLoss(n.fc8, n.label)
+    if include_acc:
+        n.acc = L.Accuracy(n.fc8, n.label)
+    return n.to_prototxt()
+
+
+if __name__ == "__main__":
+    print(caffenet())

@@ -1,0 +1,27 @@
+"""Euclidean loss authored as a Python layer (reference
+examples/pycaffe/layers/pyloss.py — same arithmetic: L = sum(diff^2)/2N,
+dbottom0 = +diff/N, dbottom1 = -diff/N), against this framework's
+functional Python-layer protocol (layers/extension.py: infer_shapes /
+forward / backward instead of the reference's setup/reshape mutation)."""
+
+import numpy as np
+
+
+class EuclideanLossLayer:
+    def infer_shapes(self, bottom_shapes):
+        if len(bottom_shapes) != 2:
+            raise Exception("Need two inputs to compute distance.")
+        if tuple(bottom_shapes[0]) != tuple(bottom_shapes[1]):
+            raise Exception("Inputs must have the same dimension.")
+        return [()]  # scalar loss
+
+    def forward(self, bottoms):
+        a, b = bottoms
+        diff = a - b
+        return [np.sum(diff ** 2) / a.shape[0] / 2.0]
+
+    def backward(self, top_diffs, bottoms):
+        a, b = bottoms
+        g = np.asarray(top_diffs[0], np.float32)
+        diff = (a - b) / a.shape[0]
+        return [g * diff, -g * diff]

@@ -232,6 +232,30 @@ class TestRingFlash:
             np.testing.assert_allclose(np.array(a), np.array(b), rtol=5e-4,
                                        atol=2e-5, err_msg=f"d{name}")
 
+    def test_fully_padded_shards_with_saturated_scores(self, rng):
+        """s=9 over an 8-way ring leaves shards 5-7 entirely padding; a
+        fully-masked flash block's clamped lse (~ -69) must NOT enter the
+        merge — with all genuine scores ~ -100 a phantom exp(-69) term
+        would dominate the denominator and collapse the output to ~0."""
+        plan = MeshPlan.data_parallel()
+        q, _, _ = qkv(rng, b=1, s=9, h=1, d=32)
+        _, k, v = qkv(rng, b=1, s=9, h=1, d=32)
+        q = jnp.abs(q) * 6.0
+        k = -jnp.abs(k) * 6.0
+        ref = attention(q, k, v)
+        out = sequence_parallel_attention(q, k, v, plan.mesh,
+                                          seq_axis="data", use_flash=True,
+                                          flash_interpret=True)
+        np.testing.assert_allclose(np.array(out), np.array(ref), rtol=2e-5,
+                                   atol=1e-6)
+        gf = jax.grad(lambda q: jnp.sum(jnp.sin(sequence_parallel_attention(
+            q, k, v, plan.mesh, seq_axis="data", use_flash=True,
+            flash_interpret=True))))(q)
+        gr = jax.grad(lambda q: jnp.sum(jnp.sin(attention(q, k, v))))(q)
+        assert np.isfinite(np.array(gf)).all()
+        np.testing.assert_allclose(np.array(gf), np.array(gr), rtol=5e-4,
+                                   atol=2e-5)
+
     def test_long_local_shards_multi_tile(self, rng):
         """ceil(s/n) > 128 exercises the paths short tests can't: padding
         to n*128 multiples (s=1030 -> 2048, local shards of 256 = two

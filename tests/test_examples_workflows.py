@@ -42,3 +42,41 @@ def test_net_surgery_example(monkeypatch):
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     assert mod.main([]) == 0
+
+
+def test_pycaffe_example(monkeypatch):
+    """NetSpec caffenet parity + gradient-exact Python loss layer
+    (reference examples/pycaffe)."""
+    monkeypatch.chdir(_ROOT)
+    spec = importlib.util.spec_from_file_location(
+        "pycaffe_run", os.path.join(_ROOT, "examples/pycaffe/run.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main([]) == 0
+
+
+@pytest.mark.slow
+def test_solvers_example(monkeypatch):
+    """All six optimizer recipes converge (reference examples/solvers)."""
+    monkeypatch.chdir(_ROOT)
+    spec = importlib.util.spec_from_file_location(
+        "solvers_run", os.path.join(_ROOT, "examples/solvers/run.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main([]) == 0
+
+
+@pytest.mark.slow
+def test_cpp_classification_example(monkeypatch):
+    """The embedded-CPython C++ classifier builds and prints the
+    reference's top-5 output format (examples/cpp_classification)."""
+    import shutil
+    if not (shutil.which("g++") and shutil.which("python3-config")):
+        pytest.skip("no C++ toolchain")
+    monkeypatch.chdir(_ROOT)
+    spec = importlib.util.spec_from_file_location(
+        "cppc_run",
+        os.path.join(_ROOT, "examples/cpp_classification/run.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main([]) == 0

@@ -44,6 +44,18 @@ def test_net_surgery_example(monkeypatch):
     assert mod.main([]) == 0
 
 
+def test_feature_extraction_example(monkeypatch):
+    """ImageData file-list -> extract_features -> dump verified against
+    a direct forward (reference examples/feature_extraction)."""
+    monkeypatch.chdir(_ROOT)
+    spec = importlib.util.spec_from_file_location(
+        "featext_run",
+        os.path.join(_ROOT, "examples/feature_extraction/run.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main(["-batches", "2"]) == 0
+
+
 def test_pycaffe_example(monkeypatch):
     """NetSpec caffenet parity + gradient-exact Python loss layer
     (reference examples/pycaffe)."""

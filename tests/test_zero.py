@@ -84,6 +84,24 @@ def test_slots_actually_sharded():
     assert shard.shape[0] == hist.shape[0] // 8
 
 
+def test_zero1_with_iter_size_accumulation():
+    """iter_size gradient accumulation (lax.scan over microbatches)
+    composes with the sharded update: same trajectory as replicated."""
+    def build(zero):
+        sp = SolverParameter.from_text(
+            f'base_lr: 0.05 momentum: 0.9 lr_policy: "fixed" max_iter: 20 '
+            f'type: "SGD" random_seed: 7 iter_size: 2 zero_stage: {zero}')
+        sp.net_param = NetParameter.from_text(NET)
+        return Solver(sp, mesh=MeshPlan.data_parallel())
+    base, zero = build(0), build(1)
+    base.step(4, feed_fn)
+    zero.step(4, feed_fn)
+    pb, pz = _params_np(base), _params_np(zero)
+    for k in pb:
+        np.testing.assert_allclose(pz[k], pb[k], rtol=2e-5, atol=2e-6,
+                                   err_msg=str(k))
+
+
 def test_zero_requires_mesh():
     sp = SolverParameter.from_text(
         'base_lr: 0.05 lr_policy: "fixed" zero_stage: 1')

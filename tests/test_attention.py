@@ -139,6 +139,23 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.array(g), np.array(gr), rtol=5e-3,
                                    atol=1e-4)
 
+    def test_bf16_inputs(self, rng):
+        """bf16 activations (the FLOAT16 policy) through the kernels:
+        compute is f32 internally, output returns bf16, and fwd/bwd track
+        the f32 reference at bf16 resolution."""
+        from caffe_mpi_tpu.ops.flash_attention import flash_attention
+        q, k, v = qkv(rng, b=1, s=128, h=2, d=16)
+        qb, kb, vb = (t.astype(jnp.bfloat16) for t in (q, k, v))
+        out = flash_attention(qb, kb, vb, causal=True, interpret=True)
+        assert out.dtype == jnp.bfloat16
+        ref = attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.array(out, np.float32), np.array(ref),
+                                   rtol=2e-2, atol=2e-2)
+        g = jax.grad(lambda qb: jnp.sum(flash_attention(
+            qb, kb, vb, causal=True, interpret=True).astype(jnp.float32)))(qb)
+        assert g.dtype == jnp.bfloat16
+        assert np.isfinite(np.array(g, np.float32)).all()
+
     def test_use_flash_entry_gradcheck(self, rng):
         """Finite-difference gradient check through the public
         attention(use_flash=True) entry (the framework's gradcheck bar,

@@ -248,8 +248,13 @@ class Feeder:
                     self._futures[ahead] = self.pool.submit(self._build_batch,
                                                             ahead)
             fut = self._futures.pop(it)
-            # drop stale entries (resume/seek)
-            for k in [k for k in self._futures if k < it]:
+            # drop stale entries (resume/seek) and, when a retune SHRANK
+            # the window, best-effort cancel batches scheduled beyond it —
+            # otherwise in-flight memory transiently exceeds mem_budget by
+            # the old window size. Rebuild-on-demand is safe: batches are
+            # pure functions of their index (_record_index + Philox).
+            for k in [k for k in self._futures
+                      if k < it or k > it + self.lookahead]:
                 self._futures.pop(k).cancel()
         feeds = fut.result()
         if self.to_device is not None:

@@ -82,6 +82,7 @@ class Net:
         # blob_shapes for device-transform Data layers (raw uint8 + aug)
         self.feed_specs: dict[str, tuple[tuple, str]] = {}
         self.loss_blobs: list[tuple[str, float]] = []  # (blob, weight)
+        self._loss_at: dict[str, int] = {}  # loss blob -> producing layer idx
         # param sharing: ParamSpec.name -> (owner layer, param name)
         self._shared_owner: dict[str, tuple[str, str]] = {}
         self.param_aliases: dict[tuple[str, str], tuple[str, str]] = {}
@@ -146,6 +147,7 @@ class Net:
                      else layer.default_loss_weight(ti))
                 if w:
                     self.loss_blobs.append((t, w))
+                    self._loss_at[t] = len(self.layers)
             # param sharing bookkeeping
             for pname, decl in layer.params.items():
                 key = (lp.name, pname)
@@ -262,9 +264,29 @@ class Net:
               *, train: bool, rng: jax.Array | None = None
               ) -> tuple[dict[str, jax.Array], State, jax.Array]:
         """Run the graph. Returns (all named blobs, new state, total loss)."""
-        env: dict[str, jax.Array] = {}
+        return self.apply_range(params, state, feeds, {},
+                                0, len(self.layers), train=train, rng=rng)
+
+    def apply_range(self, params: Params, state: State,
+                    feeds: dict[str, jax.Array], env: dict[str, jax.Array],
+                    lo: int, hi: int, *, train: bool,
+                    rng: jax.Array | None = None
+                    ) -> tuple[dict[str, jax.Array], State, jax.Array]:
+        """Run layers [lo, hi) — the pipeline-stage primitive.
+
+        `env` seeds the blob environment with boundary activations produced
+        by earlier layers; `feeds` serves any InputLayerBase in the range.
+        Returns (env including this range's tops, updated state, the loss
+        contribution of loss blobs PRODUCED in this range). apply() is the
+        full-range case, so stage execution and whole-net execution share
+        one code path — heterogeneous pipeline parallelism (parallel/
+        gpipe.py) is exact vs sequential by construction. RNG folding uses
+        the ABSOLUTE layer index, so per-layer streams are identical no
+        matter how the net is partitioned."""
+        env = dict(env)
         new_state: State = dict(state)
-        for i, layer in enumerate(self.layers):
+        for i in range(lo, hi):
+            layer = self.layers[i]
             lrng = jax.random.fold_in(rng, i) if rng is not None else None
             lparams = self._layer_params(layer, params, train)
             lstate = state.get(layer.name, {})
@@ -305,6 +327,8 @@ class Net:
                         m=jnp.mean(jnp.abs(v.astype(jnp.float32))))
         loss = jnp.zeros((), jnp.float32)
         for blob, w in self.loss_blobs:
+            if not lo <= self._loss_at[blob] < hi:
+                continue  # produced outside this range (another stage)
             contrib = env[blob].astype(jnp.float32)
             loss = loss + w * jnp.sum(contrib)
         return env, new_state, loss

@@ -1,0 +1,379 @@
+"""Heterogeneous pipeline parallelism: MPMD GPipe over explicit devices.
+
+The reference has no pipeline parallelism at all (SURVEY §2.7:
+ForwardFromTo is a sequential per-device loop, net.cpp:669-682).
+parallel/pipeline.py covers the SPMD shift-register case — stages must be
+structurally identical (stacked transformer blocks). This module covers
+the OTHER half of the pipeline story: nets whose stages differ in both
+computation and activation shape — every CNN in the reference zoo
+(GoogLeNet/ResNet change channel count and spatial size per stage), which
+no shift register can express because the ppermute wire type is fixed.
+
+TPU-native design — single-controller MPMD instead of SPMD:
+- Stage s = a contiguous layer range of a Net, jit-compiled ONCE and
+  pinned to its own device (computation follows its committed inputs;
+  stage params are device_put to stage s at placement time, so model
+  memory is truly partitioned 1/S per device).
+- The wire between stages is the set of boundary blobs (computed
+  statically from the graph); values cross devices via jax.device_put —
+  on hardware this is a direct ICI neighbor copy, and non-adjacent
+  crossings (a label feeding the last stage, a long skip) hop straight
+  from producer to consumer without relaying through middle stages.
+- The GPipe schedule is issued wavefront-order from Python; dispatch is
+  asynchronous, so device s computes microbatch m while device s+1
+  computes m-1 — the classic 1F-wave/1B-wave overlap without any
+  hand-written collective.
+- Backward is per-stage rematerialization (the GPipe recipe): only the
+  boundary activations are saved; each stage's backward jit recomputes
+  its forward inside jax.vjp. Peak memory is n_micro boundary blobs, not
+  n_micro full activation sets.
+
+Exactness: stages run Net.apply_range — the same code path as
+Net.apply — and RNG folds on absolute layer indices, so the pipelined
+loss/grads/state match the sequential microbatch loop bit-for-bit in
+exact arithmetic (tests assert to float tolerance).
+
+Semantics: microbatches are processed in order within each stage (layer
+state, e.g. BN running stats, updates sequentially exactly as a
+sequential loop would); the returned loss and grads are MEANS over
+microbatches — the same contract as iter_size gradient accumulation
+(reference solver.cpp:277-288).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.flops import layer_macs_per_image
+
+
+def _stage_cost(layer) -> float:
+    """Balance weight for auto-splitting: MXU MACs dominate; fall back to
+    activation size for HBM-bound layers so pure-elementwise stretches
+    still count a little."""
+    macs = layer_macs_per_image(layer)
+    act = sum(math.prod(s) for s in layer.out_shapes if s)
+    return float(macs) + 0.05 * float(act)
+
+
+def auto_boundaries(net, n_stages: int) -> list[int]:
+    """Choose stage boundaries [0=b0 < b1 < ... < b_S=n_layers] balancing
+    cumulative layer cost, preferring cut points with few crossing blobs.
+
+    All InputLayerBase layers must land in stage 0 (they are, in every
+    zoo net, the first layers). Candidate cuts are positions where the
+    number of crossing float blobs is minimal locally — for ResNet/
+    GoogLeNet these are the block seams where exactly one activation
+    (plus the integer label) crosses."""
+    n = len(net.layers)
+    if not 1 <= n_stages <= n:
+        raise ValueError(f"n_stages {n_stages} out of range for {n} layers")
+    costs = [_stage_cost(l) for l in net.layers]
+    total = sum(costs) or 1.0
+    # crossing width at each cut position (number of blobs alive across it)
+    widths = [len(boundary_blobs(net, cut, n)) for cut in range(n + 1)]
+    from ..layers.data_layers import InputLayerBase
+    first_cut = max((i + 1 for i, l in enumerate(net.layers)
+                     if isinstance(l, InputLayerBase)), default=1)
+    bounds = [0]
+    for s in range(1, n_stages):
+        target = total * s / n_stages
+        # best cut near the cost quantile: minimize (width, distance)
+        lo = max(bounds[-1] + 1, first_cut)
+        best, best_key = None, None
+        run = 0.0
+        for cut in range(1, n):
+            run += costs[cut - 1]
+            if cut < lo:
+                continue
+            if cut > n - (n_stages - s):  # leave room for later stages
+                break
+            key = (widths[cut], abs(run - target) / total)
+            if best_key is None or key < best_key:
+                best, best_key = cut, key
+        if best is None:
+            raise ValueError("could not place stage boundaries")
+        bounds.append(best)
+    bounds.append(n)
+    return bounds
+
+
+def boundary_blobs(net, lo: int, hi: int) -> list[str]:
+    """Blobs that layers [lo, hi) consume but that were last produced by
+    an earlier layer (the stage's wire-in set). Sorted for determinism."""
+    produced_before = set()
+    for l in net.layers[:lo]:
+        produced_before.update(l.lp.top)
+    produced_in: set[str] = set()
+    need: set[str] = set()
+    for l in net.layers[lo:hi]:
+        for b in l.lp.bottom:
+            if b not in produced_in:
+                if b not in produced_before:
+                    raise ValueError(f"blob {b!r} undefined before layer "
+                                     f"range [{lo},{hi})")
+                need.add(b)
+        produced_in.update(l.lp.top)
+    return sorted(need)
+
+
+class GPipe:
+    """Pipelined trainer over a Net partitioned into heterogeneous stages.
+
+    devices: one jax device per stage (defaults: first S of jax.devices()).
+    boundaries: explicit [0, ..., n_layers] cut list, or None to
+    auto-balance by analytic layer cost.
+    """
+
+    def __init__(self, net, n_stages: int | None = None, *,
+                 boundaries: Sequence[int] | None = None,
+                 devices: Sequence[Any] | None = None):
+        self.net = net
+        if boundaries is None:
+            if n_stages is None:
+                raise ValueError("give n_stages or boundaries")
+            boundaries = auto_boundaries(net, n_stages)
+        boundaries = list(boundaries)
+        if (boundaries[0] != 0 or boundaries[-1] != len(net.layers)
+                or any(a >= b for a, b in zip(boundaries, boundaries[1:]))):
+            raise ValueError(f"bad boundaries {boundaries}")
+        self.bounds = boundaries
+        self.n_stages = len(boundaries) - 1
+        if devices is None:
+            devices = jax.devices()[: self.n_stages]
+        if len(devices) < self.n_stages:
+            raise ValueError(
+                f"{self.n_stages} stages need {self.n_stages} devices, "
+                f"got {len(devices)}")
+        self.devices = list(devices[: self.n_stages])
+
+        from ..layers.data_layers import InputLayerBase
+        n = len(net.layers)
+        self.in_blobs = [boundary_blobs(net, self.bounds[s],
+                                        self.bounds[s + 1])
+                         for s in range(self.n_stages)]
+        # out wire of stage s: tops (re)produced in s that some later layer
+        # still consumes — i.e. the in-wire of the remainder of the net
+        self.out_blobs = []
+        for s in range(self.n_stages):
+            hi = self.bounds[s + 1]
+            produced = set()
+            for l in net.layers[self.bounds[s]: hi]:
+                produced.update(l.lp.top)
+            rest_need = (set(boundary_blobs(net, hi, n))
+                         if hi < n else set())
+            self.out_blobs.append(sorted(produced & rest_need))
+        # the value stage s reads for wire blob b comes from the LAST stage
+        # BEFORE s that (re)produces b — per-consumer-stage, because
+        # in-place tops (conv1 -> bn1 -> relu1 all named "conv1") mean a
+        # blob name can be re-produced in a later stage than its origin
+        produced_by_stage = []
+        for s in range(self.n_stages):
+            tops: set[str] = set()
+            for l in net.layers[self.bounds[s]: self.bounds[s + 1]]:
+                tops.update(l.lp.top)
+            produced_by_stage.append(tops)
+        self._in_producer: list[dict[str, int]] = []
+        for s in range(self.n_stages):
+            prod = {}
+            for b in self.in_blobs[s]:
+                for p in range(s - 1, -1, -1):
+                    if b in produced_by_stage[p]:
+                        prod[b] = p
+                        break
+            self._in_producer.append(prod)
+        # host-feed keys per stage (InputLayerBase layers in the range)
+        self.feed_keys: list[list[str]] = []
+        for s in range(self.n_stages):
+            keys: list[str] = []
+            for l in net.layers[self.bounds[s]: self.bounds[s + 1]]:
+                if isinstance(l, InputLayerBase):
+                    keys.extend(k for k, _, _ in l.feed_specs())
+            self.feed_keys.append(keys)
+        # home stage of every layer's params (place_params pins them there)
+        self._owner_stage: dict[str, int] = {}
+        for s in range(self.n_stages):
+            for l in net.layers[self.bounds[s]: self.bounds[s + 1]]:
+                self._owner_stage[l.name] = s
+        # param layers each stage needs (its own + shared-owner layers
+        # that live elsewhere); grads for a shared owner accumulate from
+        # every referencing stage
+        self.param_layers: list[list[str]] = []
+        for s in range(self.n_stages):
+            names: set[str] = set()
+            for l in net.layers[self.bounds[s]: self.bounds[s + 1]]:
+                for pname in l.params:
+                    owner = net.param_aliases.get((l.name, pname),
+                                                  (l.name, pname))
+                    names.add(owner[0])
+            self.param_layers.append(sorted(names))
+        self.state_layers = [
+            [l.name for l in net.layers[self.bounds[s]: self.bounds[s + 1]]]
+            for s in range(self.n_stages)]
+        self._fwd = [self._make_fwd(s) for s in range(self.n_stages)]
+        self._bwd = [self._make_bwd(s) for s in range(self.n_stages)]
+
+    # ------------------------------------------------------------------
+    def place_params(self, params):
+        """device_put each stage's owned params onto its stage device —
+        the memory-partitioning step. Shared params stay with their owner
+        stage. Returns the placed params dict (same structure)."""
+        out = {}
+        for lname, tree in params.items():
+            dev = self.devices[self._owner_stage.get(lname, 0)]
+            out[lname] = {k: jax.device_put(v, dev) for k, v in tree.items()}
+        return out
+
+    def _stage_params(self, params, s: int):
+        """Stage s's param view. A shared owner living on another stage's
+        device is copied to dev[s] here — jit refuses inputs committed to
+        mixed devices, and the referencing stage genuinely needs a local
+        replica (the reference analogue: shared blobs exist once per GPU
+        anyway; here once per owning stage + a transient copy)."""
+        out = {}
+        for n in self.param_layers[s]:
+            if n not in params:
+                continue
+            tree = params[n]
+            if self._owner_stage.get(n, s) != s:
+                tree = {k: jax.device_put(v, self.devices[s])
+                        for k, v in tree.items()}
+            out[n] = tree
+        return out
+
+    def _stage_state(self, state, s: int):
+        return {n: state[n] for n in self.state_layers[s] if n in state}
+
+    def _make_fwd(self, s: int):
+        lo, hi = self.bounds[s], self.bounds[s + 1]
+        outs = self.out_blobs[s]
+        snames = self.state_layers[s]
+
+        def fwd(stage_params, stage_state, feeds, env_in, rng):
+            env, new_state, loss = self.net.apply_range(
+                stage_params, stage_state, feeds, env_in, lo, hi,
+                train=True, rng=rng)
+            return ({b: env[b] for b in outs}, loss,
+                    {k: v for k, v in new_state.items() if k in snames})
+
+        return jax.jit(fwd)
+
+    def _make_bwd(self, s: int):
+        lo, hi = self.bounds[s], self.bounds[s + 1]
+
+        def bwd(stage_params, stage_state, feeds, env_in, rng,
+                ct_out, ct_loss):
+            # ct_out's (static) keys select the differentiable out wires;
+            # integer outs (labels) are excluded by the caller
+            def f(p, e):
+                env, new_state, loss = self.net.apply_range(
+                    p, stage_state, feeds, e, lo, hi, train=True, rng=rng)
+                return ({b: env[b] for b in ct_out}, loss)
+
+            _, vjp_fn = jax.vjp(f, stage_params, env_in)
+            ct_params, ct_env = vjp_fn((ct_out, ct_loss))
+            # integer wires (labels) produce float0 cotangents — not a
+            # valid jit output type and meaningless upstream: drop here
+            ct_env = {b: v for b, v in ct_env.items()
+                      if v.dtype != jax.dtypes.float0}
+            return ct_params, ct_env
+
+        return jax.jit(bwd)
+
+    # ------------------------------------------------------------------
+    def train_step(self, params, state, microbatch_feeds: Sequence[dict],
+                   *, rngs: Sequence[jax.Array] | None = None):
+        """One pipelined step over n_micro microbatch feed dicts.
+
+        Returns (loss, grads, new_state): loss and grads are means over
+        microbatches (iter_size semantics); grads has the structure of the
+        OWNED params referenced by the net; new_state is the post-step
+        layer state (microbatches applied in order)."""
+        n_micro = len(microbatch_feeds)
+        if n_micro < 1:
+            raise ValueError("need at least one microbatch")
+        S = self.n_stages
+        if rngs is None:
+            rngs = [None] * n_micro
+        dev = self.devices
+
+        stage_params = [self._stage_params(params, s) for s in range(S)]
+        stage_state = [self._stage_state(state, s) for s in range(S)]
+        env: list[dict[str, jax.Array]] = [dict() for _ in range(n_micro)]
+        saved = [[None] * n_micro for _ in range(S)]
+        losses: list[list[jax.Array]] = [[] for _ in range(n_micro)]
+
+        # forward wavefront: at tick t stage s runs microbatch t-s
+        for t in range(S + n_micro - 1):
+            for s in range(min(t, S - 1), -1, -1):
+                m = t - s
+                if not 0 <= m < n_micro:
+                    continue
+                env_in = {b: jax.device_put(env[m][b], dev[s])
+                          for b in self.in_blobs[s]}
+                feeds = {k: jax.device_put(microbatch_feeds[m][k], dev[s])
+                         for k in self.feed_keys[s]}
+                st_in = stage_state[s]
+                saved[s][m] = (env_in, feeds, st_in, rngs[m])
+                out, loss_s, st_new = self._fwd[s](
+                    stage_params[s], st_in, feeds, env_in, rngs[m])
+                stage_state[s] = st_new
+                env[m].update(out)
+                losses[m].append(loss_s)
+
+        # backward wavefront (reverse order; cotangents accumulate on the
+        # producing stage's device)
+        ct_env: list[dict[str, jax.Array]] = [dict() for _ in range(n_micro)]
+        grads: dict[str, dict[str, jax.Array]] = {}
+        one = jnp.ones((), jnp.float32)
+        for t in range(S + n_micro - 2, -1, -1):
+            for s in range(min(t, S - 1), -1, -1):
+                m = t - s
+                if not 0 <= m < n_micro:
+                    continue
+                env_in, feeds, st_in, rng = saved[s][m]
+                saved[s][m] = None  # free the residual as soon as consumed
+                ct_out = {}
+                for b in self.out_blobs[s]:
+                    if not jnp.issubdtype(env[m][b].dtype, jnp.floating):
+                        continue  # int wires (labels) carry no gradient
+                    ct = ct_env[m].pop(b, None)
+                    if ct is None:
+                        ct = jnp.zeros(env[m][b].shape, env[m][b].dtype)
+                    ct_out[b] = jax.device_put(ct, dev[s])
+                ct_params, ct_in = self._bwd[s](
+                    stage_params[s], st_in, feeds, env_in, rng,
+                    ct_out, jax.device_put(one, dev[s]))
+                for lname, tree in ct_params.items():
+                    g = grads.setdefault(lname, {})
+                    # accumulate on the owner's device: shared params
+                    # receive cotangents from several stages' devices
+                    gdev = dev[self._owner_stage.get(lname, s)]
+                    for pname, ct in tree.items():
+                        ct = jax.device_put(ct, gdev)
+                        prev = g.get(pname)
+                        g[pname] = ct if prev is None else prev + ct
+                for b, ct in ct_in.items():
+                    if not jnp.issubdtype(ct.dtype, jnp.floating):
+                        continue  # int wire (labels): no gradient
+                    p = self._in_producer[s].get(b)
+                    if p is None:
+                        continue
+                    ct = jax.device_put(ct, dev[p])
+                    prev = ct_env[m].get(b)
+                    ct_env[m][b] = ct if prev is None else prev + ct
+
+        inv = 1.0 / n_micro
+        grads = {l: {p: g * inv for p, g in tree.items()}
+                 for l, tree in grads.items()}
+        loss = sum(jnp.sum(jnp.stack([jax.device_put(x, dev[0])
+                                      for x in losses[m]]))
+                   for m in range(n_micro)) * inv
+        new_state = dict(state)
+        for s in range(S):
+            new_state.update(stage_state[s])
+        return loss, grads, new_state

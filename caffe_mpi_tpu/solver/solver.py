@@ -513,7 +513,7 @@ class Solver:
         falls back to blocking (collective order then stays identical on
         every rank)."""
         if not block and jax.process_count() > 1 and needs_collective_gather(
-                (self.params, self.opt_state)):
+                (self.params, self.net_state, self.opt_state)):
             block = True
         if block:
             view = (self.params, self.net_state, self.opt_state, self.iter,
@@ -554,7 +554,7 @@ class Solver:
                         current_step) -> str:
         from .. import io as caffe_io
         if self.rank != 0 and not needs_collective_gather(
-                (params, opt_state)):
+                (params, net_state, opt_state)):
             # non-root with nothing collective to contribute: skip the
             # full model device->host copy (costly over the tunnel)
             return ""

@@ -61,10 +61,27 @@ def run_contained(cmd: list[str], timeout: float, cwd: str | None = None,
     every exit path, including the parent being SIGTERM'd.
     """
     _install_hooks()
-    proc = subprocess.Popen(cmd, cwd=cwd, env=env, text=True,
-                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                            start_new_session=True)
-    _ACTIVE.add(proc.pid)
+    # Mask the handled signals across Popen -> _ACTIVE.add: a SIGTERM
+    # landing in that window would run _reap_all without knowing the new
+    # child, leaking a chip-claiming orphan — the exact failure this
+    # module exists to prevent. Caveat: pthread_sigmask masks THIS thread
+    # only, so the window closes fully only for single-threaded callers
+    # (tpu_validation, bench_models — the ones that matter); a
+    # process-directed signal may still land on another unblocked thread.
+    _sigs = {signal.SIGTERM, signal.SIGINT, signal.SIGHUP}
+    try:
+        prev_mask = signal.pthread_sigmask(signal.SIG_BLOCK, _sigs)
+    except (ValueError, OSError):  # non-main thread restrictions etc.
+        prev_mask = None
+    try:
+        proc = subprocess.Popen(cmd, cwd=cwd, env=env, text=True,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE,
+                                start_new_session=True)
+        _ACTIVE.add(proc.pid)
+    finally:
+        if prev_mask is not None:
+            signal.pthread_sigmask(signal.SIG_SETMASK, prev_mask)
     try:
         out, err = proc.communicate(timeout=timeout)
         return proc.returncode, out, err

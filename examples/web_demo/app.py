@@ -133,7 +133,15 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         if urlparse(self.path).path != "/classify":
             return self._json(404, {"error": "POST /classify"})
-        length = int(self.headers.get("Content-Length", 0))
+        if "chunked" in (self.headers.get("Transfer-Encoding") or "").lower():
+            # http.server doesn't de-chunk; demand a sized body instead of
+            # reading 0 bytes and emitting a confusing decode error.
+            return self._json(411, {"error": "Content-Length required "
+                                             "(chunked uploads unsupported)"})
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:  # garbled header is a client error, not a crash
+            return self._json(400, {"error": "bad Content-Length"})
         body = self.rfile.read(length)
         try:
             img = _decode(_extract_image_bytes(

@@ -130,6 +130,10 @@ def main() -> int:
     if _CHILD:
         print(json.dumps(bench_one(_CHILD)))
         return 0
+    if any(a in ("-h", "--help") for a in sys.argv[1:]):
+        print(__doc__)
+        print(f"known model keys: {sorted(SWEEP)}")
+        return 0
     keys = sys.argv[1:] or DEFAULT
     bad = [k for k in keys if k not in SWEEP]
     if bad:

@@ -1,0 +1,33 @@
+#!/bin/bash
+# Poll the axon TPU tunnel. Writes one status line per probe to
+# tools/tunnel_watch.log; exits 0 the first time a probe succeeds.
+# Probe = TCP connect to the relay port (cheap, no chip claim) followed
+# by a real jax.devices() only when the port is open — so a dead relay
+# costs nothing and a live one is confirmed end-to-end.
+LOG=/root/repo/tools/tunnel_watch.log
+INTERVAL=${1:-300}
+while true; do
+  ts=$(date +%H:%M:%S)
+  if python - <<'EOF'
+import socket, sys
+s = socket.socket(); s.settimeout(2)
+try:
+    s.connect(("127.0.0.1", 8082)); sys.exit(0)
+except Exception:
+    sys.exit(1)
+finally:
+    s.close()
+EOF
+  then
+    echo "$ts port-open, probing devices" >> "$LOG"
+    if timeout 120 python -c "import jax; print(jax.devices())" >> "$LOG" 2>&1; then
+      echo "$ts TUNNEL LIVE" >> "$LOG"
+      exit 0
+    else
+      echo "$ts devices probe failed/timed out" >> "$LOG"
+    fi
+  else
+    echo "$ts port 8082 closed" >> "$LOG"
+  fi
+  sleep "$INTERVAL"
+done

@@ -56,19 +56,48 @@ while true; do
         if [ "$vrc" -eq 0 ]; then
           # Worst case for the zoo sweep is ~7 models x 900 s per-model
           # deadline; give it the full budget and only promote the log on
-          # completion so a killed run can't clobber evidence.
+          # completion so a killed run can't clobber earlier evidence.
           timeout 7200 python tools/bench_models.py \
             > docs/bench_models_r05.log.partial 2>&1
           brc=$?
-          mv docs/bench_models_r05.log.partial docs/bench_models_r05.log
+          if [ "$brc" -eq 0 ]; then
+            mv docs/bench_models_r05.log.partial docs/bench_models_r05.log
+          else
+            cp docs/bench_models_r05.log.partial \
+              docs/bench_models_r05_truncated.log
+          fi
         fi
         echo "$(date +%H:%M:%S) capture done (validation rc=$vrc, zoo rc=$brc)" >> "$LOG"
-        git add -f tpu_validation.log docs/bench_models_r05.log 2>>"$LOG"
-        # pathspec-scoped commit: must not sweep unrelated staged work
-        # into an automated evidence commit
-        git commit -m "Hardware evidence auto-captured by tunnel watchdog (validation rc=$vrc, zoo sweep rc=$brc)" \
-          -- tpu_validation.log docs/bench_models_r05.log >> "$LOG" 2>&1
-        exit 0
+        # Commit whatever evidence actually exists — an aborted validation
+        # leaves only the .partial, a killed sweep only the truncated copy;
+        # every failure path must still land its evidence. git add aborts
+        # entirely on one unmatched pathspec, so build the list first.
+        evidence=""
+        for f in tpu_validation.log docs/bench_models_r05.log \
+                 docs/bench_models_r05_truncated.log; do
+          [ -f "$f" ] && evidence="$evidence $f"
+        done
+        if [ -f tpu_validation.log.partial ]; then
+          cp tpu_validation.log.partial docs/tpu_validation_r05_partial.log
+          evidence="$evidence docs/tpu_validation_r05_partial.log"
+        fi
+        committed=1
+        if [ -n "$evidence" ]; then
+          git add -f -- $evidence >> "$LOG" 2>&1
+          # pathspec-scoped commit: must not sweep unrelated staged work
+          # into an automated evidence commit
+          if git commit -m "Hardware evidence auto-captured by tunnel watchdog (validation rc=$vrc, zoo sweep rc=$brc)" \
+              -- $evidence >> "$LOG" 2>&1; then
+            committed=0
+            echo "$(date +%H:%M:%S) evidence committed" >> "$LOG"
+          else
+            echo "$(date +%H:%M:%S) commit failed or nothing new" >> "$LOG"
+          fi
+        fi
+        # Done only when the full checklist ran AND its evidence landed;
+        # otherwise keep polling for a better window.
+        [ "$vrc" -eq 0 ] && [ "$committed" -eq 0 ] && exit 0
+        exit 4
       else
         echo "$ts devices probe failed/timed out" >> "$LOG"
         exit 3
@@ -76,7 +105,10 @@ while true; do
     ) 9>"$LOCK"
     rc=$?
     [ "$rc" -eq 0 ] && exit 0
-    # port open but probe failed (stray holder / half-dead relay): keep polling
+    # capture incomplete (stray holder, half-dead relay, timed-out
+    # validation): keep polling, with extra backoff so a flapping tunnel
+    # doesn't re-trigger the heavy checklist every 2 minutes
+    sleep 480
   else
     echo "$ts port 8082 closed" >> "$LOG"
   fi

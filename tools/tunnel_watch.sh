@@ -47,9 +47,9 @@ while true; do
   if probe_port; then
     echo "$ts port-open, acquiring host lock" >> "$LOG"
     (
-      flock -w 3600 9 || { echo "$ts lock timeout" >> "$LOG"; exit 1; }
+      flock -w 3600 9 || { echo "$(date +%H:%M:%S) lock timeout" >> "$LOG"; exit 1; }
       if timeout 120 python -c "import jax; print(jax.devices())" >> "$LOG" 2>&1; then
-        echo "$ts TUNNEL LIVE — capturing hardware evidence" >> "$LOG"
+        echo "$(date +%H:%M:%S) TUNNEL LIVE — capturing hardware evidence" >> "$LOG"
         timeout 3600 python tools/tpu_validation.py >> "$LOG" 2>&1
         vrc=$?
         brc=skipped
@@ -83,20 +83,29 @@ while true; do
         fi
         committed=1
         if [ -n "$evidence" ]; then
-          git add -f -- $evidence >> "$LOG" 2>&1
-          # pathspec-scoped commit: must not sweep unrelated staged work
-          # into an automated evidence commit
-          if git commit -m "Hardware evidence auto-captured by tunnel watchdog (validation rc=$vrc, zoo sweep rc=$brc)" \
-              -- $evidence >> "$LOG" 2>&1; then
-            committed=0
-            echo "$(date +%H:%M:%S) evidence committed" >> "$LOG"
-          else
-            echo "$(date +%H:%M:%S) commit failed or nothing new" >> "$LOG"
-          fi
+          # The capture (hours, chip-claiming) and the commit (cheap,
+          # host-only) fail independently: retry only the commit — e.g. a
+          # transient .git/index.lock — never the capture. Pathspec-scoped
+          # so unrelated staged work is not swept in.
+          for attempt in 1 2 3 4 5; do
+            git add -f -- $evidence >> "$LOG" 2>&1
+            if git commit -m "Hardware evidence auto-captured by tunnel watchdog (validation rc=$vrc, zoo sweep rc=$brc)" \
+                -- $evidence >> "$LOG" 2>&1; then
+              committed=0
+              echo "$(date +%H:%M:%S) evidence committed" >> "$LOG"
+              break
+            fi
+            echo "$(date +%H:%M:%S) commit attempt $attempt failed (or nothing new)" >> "$LOG"
+            sleep 60
+          done
         fi
-        # Done only when the full checklist ran AND its evidence landed;
-        # otherwise keep polling for a better window.
-        [ "$vrc" -eq 0 ] && [ "$committed" -eq 0 ] && exit 0
+        if [ "$vrc" -eq 0 ]; then
+          # Full checklist captured. Even if every commit attempt failed,
+          # the evidence is on disk and the round driver commits leftover
+          # work at round end — do NOT burn another chip-claiming recapture
+          # over a commit hiccup.
+          exit 0
+        fi
         exit 4
       else
         echo "$ts devices probe failed/timed out" >> "$LOG"

@@ -38,14 +38,28 @@ PROBE_DEADLINE_S = 90       # tiny device op, incl. client init + tunnel RTT
 TOTAL_BUDGET_S = 450        # hard cap: probe + compile (~40s) + 23 steps
 _IS_CHILD = os.environ.get("CAFFE_TPU_BENCH_CHILD") == "1"
 
-# debug knobs (the headline metric is always batch 256, 20 iters; overriding
-# any knob renames the metric so a debug line can't be mistaken for it)
+# debug/staged knobs (the headline metric is always AlexNet f32 batch 256,
+# 20 iters; overriding any knob renames the metric so an alternate line
+# can't be mistaken for it). Staged configs for a hardware window
+# (docs/mfu_analysis.md): CAFFE_BENCH_DTYPE=bf16 switches to the fp16
+# prototxt variant (FLOAT16 -> bf16 storage, f32 master weights);
+# CAFFE_BENCH_MODEL=resnet50 benches the north-star topology.
 BATCH = int(os.environ.get("CAFFE_BENCH_BATCH", 256))
 WARMUP = int(os.environ.get("CAFFE_BENCH_WARMUP", 3))
 ITERS = int(os.environ.get("CAFFE_BENCH_ITERS", 20))
-_IS_DEBUG = (BATCH, ITERS, WARMUP) != (256, 20, 3)
+MODEL = os.environ.get("CAFFE_BENCH_MODEL", "alexnet")
+DTYPE = os.environ.get("CAFFE_BENCH_DTYPE", "f32")
+_SOLVERS = {
+    ("alexnet", "f32"): "models/alexnet/solver.prototxt",
+    ("alexnet", "bf16"): "models/alexnet/solver_fp16.prototxt",
+    ("resnet50", "f32"): "models/resnet50/solver.prototxt",
+    ("resnet50", "bf16"): "models/resnet50/solver_fp16.prototxt",
+}
+_IS_DEBUG = (BATCH, ITERS, WARMUP, MODEL, DTYPE) != (256, 20, 3,
+                                                     "alexnet", "f32")
 METRIC = ("alexnet_b256_train_img_per_s_1chip" if not _IS_DEBUG
-          else f"debug_alexnet_b{BATCH}_i{ITERS}_train_img_per_s_1chip")
+          else f"debug_{MODEL}_{DTYPE}_b{BATCH}_i{ITERS}"
+               "_train_img_per_s_1chip")
 
 
 def emit(value=None, vs_baseline=None, extra=None, error=None):
@@ -91,27 +105,34 @@ def run_bench():
     from caffe_mpi_tpu.solver import Solver
     from caffe_mpi_tpu.utils.flops import peak_flops, train_flops_per_image
 
-    sp = SolverParameter.from_file(
-        os.path.join(_ROOT, "models/alexnet/solver.prototxt"))
+    try:
+        solver_path = _SOLVERS[(MODEL, DTYPE)]
+    except KeyError:
+        raise SystemExit(f"unknown bench config model={MODEL} dtype={DTYPE}; "
+                         f"known: {sorted(_SOLVERS)}")
+    sp = SolverParameter.from_file(os.path.join(_ROOT, solver_path))
     sp.max_iter = 10**9
     sp.display = 0
     sp.snapshot = 0
     sp.test_interval = 0
-    if BATCH != 256:  # debug runs: rewrite the Input batch dim
-        npar = NetParameter.from_file(os.path.join(_ROOT, sp.net))
-        for l in npar.layer:
-            if l.type == "Input":
-                for shp in l.input_param.shape:
-                    shp.dim[0] = BATCH
-        sp.net = ""
-        sp.net_param = npar
+    npar = NetParameter.from_file(os.path.join(_ROOT, sp.net))
+    shapes = {}
+    for l in npar.layer:
+        if l.type == "Input":
+            for top, shp in zip(l.top, l.input_param.shape):
+                shp.dim[0] = BATCH
+                shapes[top] = list(shp.dim)
+    sp.net = ""
+    sp.net_param = npar
     solver = Solver(sp, model_dir=_ROOT)
 
     r = np.random.RandomState(0)
-    feeds = {
-        "data": jnp.asarray(r.randn(BATCH, 3, 227, 227).astype(np.float32)),
-        "label": jnp.asarray(r.randint(0, 1000, BATCH)),
-    }
+    feeds = {}
+    for top, dims in shapes.items():
+        if top == "label":
+            feeds[top] = jnp.asarray(r.randint(0, 1000, dims[0]))
+        else:
+            feeds[top] = jnp.asarray(r.randn(*dims).astype(np.float32))
     feed_fn = lambda it: feeds
 
     # warmup (compile + first steps)

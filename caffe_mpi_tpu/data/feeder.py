@@ -252,16 +252,28 @@ class Feeder:
             # the window, best-effort cancel batches scheduled beyond it —
             # otherwise in-flight memory transiently exceeds mem_budget by
             # the old window size. Rebuild-on-demand is safe: batches are
-            # pure functions of their index (_record_index + Philox).
+            # pure functions of their index (_record_index + Philox). A
+            # future that is already RUNNING can't be cancelled; it is
+            # popped anyway (its memory frees when the build finishes) but
+            # gets a done-callback so an exception it raises is logged
+            # rather than silently swallowed with the dropped handle.
             for k in [k for k in self._futures
                       if k < it or k > it + self.lookahead]:
-                self._futures.pop(k).cancel()
+                dropped = self._futures.pop(k)
+                if not dropped.cancel():
+                    dropped.add_done_callback(self._log_abandoned)
         feeds = fut.result()
         if self.to_device is not None:
             feeds = self.to_device(feeds)
         if self.auto:
             self._last_exit = time.perf_counter()
         return feeds
+
+    @staticmethod
+    def _log_abandoned(fut) -> None:
+        exc = None if fut.cancelled() else fut.exception()
+        if exc is not None:
+            log.warning("abandoned prefetch batch raised: %r", exc)
 
     def close(self) -> None:
         self.pool.shutdown(wait=False, cancel_futures=True)

@@ -21,6 +21,7 @@ per-layer dtype policy.
 
 from __future__ import annotations
 
+import copy
 import logging
 from typing import Any, Sequence
 
@@ -100,7 +101,11 @@ class Net:
                 lp.forward_math, param.default_forward_math,
                 lp.backward_math, param.default_backward_math,
             )
-            if lp.type in ("Data", "ImageData") and batch_divisor > 1:
+            if lp.type in ("Data", "ImageData", "Input") and batch_divisor > 1:
+                # copy-on-write: the NetParameter is often SHARED between
+                # the train net (divided) and test nets / the caller's
+                # object — in-place division would leak across phases
+                lp = copy.deepcopy(lp)
                 self._divide_batch(lp, batch_divisor)
             layer = create_layer(lp, policy, phase)
             layer.model_dir = model_dir  # base for any layer-level file paths
@@ -194,6 +199,21 @@ class Net:
 
     # ------------------------------------------------------------------
     def _divide_batch(self, lp, divisor: int) -> None:
+        if lp.type == "Input":
+            # Input nets (synthetic / deploy): the leading dim of every
+            # declared shape is the batch — divide it like a data layer's
+            # batch_size (gpipe micro-batching reaches here)
+            ip = lp.input_param
+            if ip:
+                for shape in ip.shape:
+                    if shape.dim:
+                        b = shape.dim[0]
+                        if b % divisor:
+                            log.warning(
+                                "layer %s: input batch %d not divisible by "
+                                "%d; rounding up", lp.name, b, divisor)
+                        shape.dim[0] = max(1, (b + divisor - 1) // divisor)
+            return
         p = lp.data_param if lp.type == "Data" else lp.image_data_param
         if p and p.batch_size:
             if p.batch_size % divisor:

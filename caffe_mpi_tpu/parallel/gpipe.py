@@ -228,12 +228,30 @@ class GPipe:
             out[lname] = {k: jax.device_put(v, dev) for k, v in tree.items()}
         return out
 
+    def owner_stage(self, lname: str) -> int:
+        """Home stage of a layer's params (where place_params pins them and
+        where the optimizer update for them runs)."""
+        return self._owner_stage.get(lname, 0)
+
+    def owned_param_layers(self, s: int, params) -> list[str]:
+        """Layers whose params live on stage s — the partition the
+        stage-local optimizer update operates on."""
+        return sorted(ln for ln in params
+                      if self._owner_stage.get(ln, 0) == s)
+
     def _stage_params(self, params, s: int):
         """Stage s's param view. A shared owner living on another stage's
         device is copied to dev[s] here — jit refuses inputs committed to
         mixed devices, and the referencing stage genuinely needs a local
         replica (the reference analogue: shared blobs exist once per GPU
-        anyway; here once per owning stage + a transient copy)."""
+        anyway; here once per owning stage + a transient copy).
+
+        Cost note: because params change every optimizer step, this copy
+        recurs per referencing stage per train_step — but ONLY for params
+        genuinely shared across a stage boundary (owner_stage != s); the
+        zoo CNNs share nothing cross-stage and pay zero. Siamese-style
+        nets that tie weights across distant layers should pick
+        boundaries that colocate the tied layers in one stage."""
         out = {}
         for n in self.param_layers[s]:
             if n not in params:

@@ -68,7 +68,7 @@ class Solver:
     def __init__(self, sp: SolverParameter, *, model_dir: str = "",
                  batch_divisor: int = 1, grad_transform=None,
                  data_shape_probe=None, rank: int = 0, mesh=None,
-                 param_shardings=None):
+                 param_shardings=None, gpipe=None):
         """grad_transform: hook applied to the grad pytree inside the jitted
         step — a custom distributed layer can pass lambda g: psum(g)/n here,
         playing the role of the reference's P2PSync::allreduce callback.
@@ -80,7 +80,19 @@ class Solver:
 
         param_shardings: optional {layer_name: spec} tensor-parallel rules
         (see MeshPlan.param_sharding_rules) — sharded layers' weights live
-        split over the 'model' axis and GSPMD partitions their matmuls."""
+        split over the 'model' axis and GSPMD partitions their matmuls.
+
+        gpipe: heterogeneous MPMD pipeline training (parallel/gpipe.py) —
+        an int stage count, or {"stages": S, "micro": M, "devices": [...],
+        "boundaries": [...]}. The net is cut into S stages, each pinned to
+        its own device; every iteration the global batch splits into M
+        microbatches (default S) wavefront-scheduled GPipe-style, and the
+        optimizer update runs PER STAGE on that stage's device over the
+        params it owns (no cross-device gather in the train loop). The
+        reference wires its parallelism into the train entrypoint the same
+        way (tools/caffe.cpp:223-225 hands the solver to P2PManager::Run);
+        mutually exclusive with mesh/zero_stage, and iter_size must be 1
+        (microbatches already carry the accumulation semantics)."""
         self.sp = sp
         self.type = solver_type(sp)
         if self.type not in UPDATE_FNS:
@@ -89,6 +101,20 @@ class Solver:
         self.rank = rank
 
         self.model_dir = model_dir
+        # gpipe micro-batching follows the reference's divide_batch
+        # semantics (parallel.cpp:295-348): the prototxt batch is the
+        # GLOBAL per-iteration batch; the net is built at batch/M and the
+        # feed_fn is consulted M times per iteration (iter_size-style).
+        self._gpipe_cfg = None
+        self._gpipe_micro = 0
+        if gpipe:
+            cfg = {"stages": gpipe} if isinstance(gpipe, int) else dict(gpipe)
+            n_st = cfg.get("stages") or (len(cfg.get("boundaries") or []) - 1)
+            if not n_st or n_st < 1:
+                raise ValueError("gpipe needs stages >= 1 (or boundaries)")
+            self._gpipe_micro = int(cfg.get("micro") or 0) or int(n_st)
+            self._gpipe_cfg = cfg
+            batch_divisor = batch_divisor * self._gpipe_micro
         train_param = _load_net_param(sp, "TRAIN", model_dir)
         # train_state/test_state: extra stage/level selectors
         # (reference solver.cpp:41-105 merges them into the NetState)
@@ -136,6 +162,7 @@ class Solver:
             if unknown:
                 raise ValueError(
                     f"param_shardings for unknown layers: {sorted(unknown)}")
+        self.gpipe = None
         if mesh is not None:
             # startup weight broadcast (reference parallel.cpp:208-227) —
             # replicated by default, or tensor-parallel-sharded per rules
@@ -144,6 +171,29 @@ class Solver:
             self.net.bind_mesh(mesh)
             for tnet in self.test_nets:
                 tnet.bind_mesh(mesh)
+        if self._gpipe_cfg is not None:
+            if mesh is not None:
+                raise ValueError("gpipe and mesh are mutually exclusive "
+                                 "(pipeline stages own whole devices)")
+            if zero:
+                raise ValueError("zero_stage with gpipe is unsupported")
+            if max(sp.iter_size, 1) > 1:
+                raise ValueError(
+                    "iter_size > 1 under gpipe is redundant: micro_batches "
+                    "already accumulate with iter_size semantics")
+            if sp.global_grad_scale and sp.global_grad_scale != 1.0:
+                raise ValueError("global_grad_scale (fp16 loss scaling) is "
+                                 "not plumbed through gpipe stages yet")
+            if grad_transform is not None:
+                raise ValueError("grad_transform hooks into the SPMD step; "
+                                 "unsupported under gpipe")
+            cfg = self._gpipe_cfg
+            from ..parallel.gpipe import GPipe
+            self.gpipe = GPipe(self.net, cfg.get("stages"),
+                               boundaries=cfg.get("boundaries"),
+                               devices=cfg.get("devices"))
+            self._gpipe_updates = None  # single jit, built lazily
+            self._place_params_opt()
         self.iter = 0
         # nets with host-callback layers (DetectNetTransformation) re-enter
         # Python from inside the compiled step; on the CPU backend (whose
@@ -199,8 +249,22 @@ class Solver:
         return rules
 
     def _place_params_opt(self) -> None:
-        """(Re)apply mesh placement to params + optimizer slots — used at
-        init and after restore/load_weights so TP shardings survive."""
+        """(Re)apply mesh/gpipe placement to params + optimizer slots —
+        used at init and after restore/load_weights so TP shardings (and
+        stage placements) survive a checkpoint round-trip."""
+        if self.gpipe is not None:
+            # stage-partitioned model memory: each layer's params AND its
+            # optimizer slots live on the owning stage's device, so the
+            # per-stage update runs without any cross-device traffic
+            gp = self.gpipe
+            self.params = gp.place_params(self.params)
+            self.opt_state = {
+                ln: {pn: tuple(
+                    jax.device_put(s, gp.devices[gp.owner_stage(ln)])
+                    for s in slots)
+                    for pn, slots in lo.items()}
+                for ln, lo in self.opt_state.items()}
+            return
         mesh = self.mesh
         if mesh is None:
             return
@@ -346,6 +410,97 @@ class Solver:
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
     # ------------------------------------------------------------------
+    # GPipe mode: the train step is the MPMD wavefront in
+    # parallel/gpipe.py; the optimizer update runs per stage, on the
+    # stage's own device, over the params that stage owns — the pipelined
+    # analogue of the reference's per-GPU fused update after the reduce
+    # (net.cpp:844, sgd_solver.cpp:143-149). One jitted update serves all
+    # stages (jax re-specializes per input structure/device).
+    def _build_gpipe_update(self):
+        sp = self.sp
+        update_fn = self.update_fn
+        if self.type == "RMSProp":
+            update_fn = partial(update_fn, rms_decay=sp.rms_decay)
+        decls = self._decls
+
+        def upd(params_s, grads_s, opt_s, rate, mom, it, gscale):
+            hyper = Hyper(rate=rate, momentum=mom, momentum2=sp.momentum2,
+                          delta=sp.delta, weight_decay=sp.weight_decay,
+                          reg_l1=(sp.regularization_type == "L1"),
+                          t=it + 1)
+            new_p, new_o = {}, {}
+            for ln, lparams in params_s.items():
+                new_p[ln], new_o[ln] = {}, {}
+                for pn, w in lparams.items():
+                    decl = decls[ln][pn]
+                    g = grads_s.get(ln, {}).get(pn)
+                    slots = opt_s[ln][pn]
+                    if decl.lr_mult == 0.0 or g is None:
+                        new_p[ln][pn] = w
+                        new_o[ln][pn] = slots
+                        continue
+                    g = g.astype(jnp.float32) * gscale
+                    w32 = w.astype(jnp.float32)
+                    w2, slots2 = update_fn(w32, g, slots, hyper,
+                                           decl.lr_mult, decl.decay_mult)
+                    new_p[ln][pn] = w2.astype(w.dtype)
+                    new_o[ln][pn] = slots2
+            return new_p, new_o
+
+        return jax.jit(upd, donate_argnums=(0, 2))
+
+    def _gpipe_iteration(self, feed_fn):
+        """One pipelined iteration: M net-shaped micro-batch feeds (the net
+        was built at prototxt_batch / M — divide_batch semantics), the
+        GPipe wavefront, then stage-local updates. Returns (device loss,
+        learning rate)."""
+        gp, M = self.gpipe, self._gpipe_micro
+        micro = [feed_fn(self.iter * M + m) for m in range(M)]
+        rng = jax.random.fold_in(self.base_rng, self.iter + 1)
+        rngs = list(jax.random.split(rng, M))
+        loss, grads, self.net_state = gp.train_step(
+            self.params, self.net_state, micro, rngs=rngs)
+
+        if self._gpipe_updates is None:
+            self._gpipe_updates = self._build_gpipe_update()
+            self._gpipe_sqnorm = jax.jit(lambda g: sum(
+                jnp.sum(jnp.square(x)).astype(jnp.float32)
+                for x in jax.tree.leaves(g)))
+        gscale = 1.0
+        if self.sp.clip_gradients > 0:
+            # the clip norm spans ALL stages: per-stage partial sums stay
+            # on their devices, hop to stage 0, and ONE float() pays the
+            # only host sync of the iteration (never float() in a loop —
+            # each call is a tunnel RTT)
+            parts = []
+            for s in range(gp.n_stages):
+                gs = {ln: grads[ln]
+                      for ln in gp.owned_param_layers(s, grads)}
+                if gs:
+                    parts.append(jax.device_put(self._gpipe_sqnorm(gs),
+                                                gp.devices[0]))
+            gnorm = float(sum(parts)) ** 0.5
+            if gnorm > self.sp.clip_gradients:
+                gscale = self.sp.clip_gradients / gnorm
+
+        it = jnp.int32(self.iter)
+        rate = lr_policy.learning_rate(self.sp, it)
+        mom = lr_policy.momentum(self.sp, it)
+        upd = self._gpipe_updates
+        for s in range(gp.n_stages):
+            owned = gp.owned_param_layers(s, self.params)
+            if not owned:
+                continue
+            p_s = {ln: self.params[ln] for ln in owned}
+            g_s = {ln: grads[ln] for ln in owned if ln in grads}
+            o_s = {ln: self.opt_state[ln] for ln in owned}
+            new_p, new_o = upd(p_s, g_s, o_s, rate, mom, it,
+                               jnp.float32(gscale))
+            self.params.update(new_p)
+            self.opt_state.update(new_o)
+        return loss, rate
+
+    # ------------------------------------------------------------------
     def step(self, n: int, feed_fn: FeedFn, test_feed_fns=None) -> float:
         """Run n training iterations (reference Solver::Step)."""
         if self._step_jit is None:
@@ -354,30 +509,36 @@ class Solver:
         iter_size = max(sp.iter_size, 1)
         last_loss = float("nan")
         t0, it0 = time.time(), self.iter
-        imgs_per_iter = self._batch_images() * iter_size
+        imgs_per_iter = self._batch_images() * iter_size \
+            * max(self._gpipe_micro, 1)
         while n > 0:
             if (sp.test_interval and self.iter % sp.test_interval == 0
                     and (self.iter > 0 or sp.test_initialization)
                     and test_feed_fns):
                 self.test_all(test_feed_fns)
-            micro_feeds = [feed_fn(self.iter * iter_size + k)
-                           for k in range(iter_size)]
-            if iter_size == 1:
-                # view, not copy: the common path skips the host-side stack
-                feeds_stack = jax.tree.map(lambda x: jnp.asarray(x)[None],
-                                           micro_feeds[0])
+            if self.gpipe is not None:
+                loss, rate = self._gpipe_iteration(feed_fn)
             else:
-                feeds_stack = jax.tree.map(lambda *xs: jnp.stack(xs),
-                                           *micro_feeds)
-            if self.mesh is not None:
-                # global batch sharded over the 'data' mesh axis
-                # (divide_batch_size semantics, parallel.cpp:295-348)
-                feeds_stack = self.mesh.shard_feeds(feeds_stack, batch_axis=1)
-            rng = jax.random.fold_in(self.base_rng, self.iter + 1)
-            it = jnp.int32(self.iter)
-            (self.params, self.net_state, self.opt_state, loss,
-             rate) = self._step_jit(self.params, self.net_state,
-                                    self.opt_state, feeds_stack, it, rng)
+                micro_feeds = [feed_fn(self.iter * iter_size + k)
+                               for k in range(iter_size)]
+                if iter_size == 1:
+                    # view, not copy: the common path skips the host-side
+                    # stack
+                    feeds_stack = jax.tree.map(
+                        lambda x: jnp.asarray(x)[None], micro_feeds[0])
+                else:
+                    feeds_stack = jax.tree.map(lambda *xs: jnp.stack(xs),
+                                               *micro_feeds)
+                if self.mesh is not None:
+                    # global batch sharded over the 'data' mesh axis
+                    # (divide_batch_size semantics, parallel.cpp:295-348)
+                    feeds_stack = self.mesh.shard_feeds(feeds_stack,
+                                                        batch_axis=1)
+                rng = jax.random.fold_in(self.base_rng, self.iter + 1)
+                it = jnp.int32(self.iter)
+                (self.params, self.net_state, self.opt_state, loss,
+                 rate) = self._step_jit(self.params, self.net_state,
+                                        self.opt_state, feeds_stack, it, rng)
             if self._sync_steps:
                 jax.block_until_ready(loss)
             # keep the loss ON DEVICE: a float() here would force a host
@@ -452,10 +613,17 @@ class Solver:
             fwd = self._test_fwd_jits[ti]
             # test nets share the train net's weights by layer name
             # (reference ShareTrainedLayersWith)
+            tparams = self._shared_params(tnet)
+            tstate = self.net_state
+            if self.gpipe is not None:
+                # stage-placed params are committed to different devices;
+                # evaluation runs whole-net on stage-0's device
+                dev0 = self.gpipe.devices[0]
+                tparams = jax.device_put(tparams, dev0)
+                tstate = jax.device_put(tstate, dev0)
             acc = None
             for k in range(iters):
-                sums = fwd(self._shared_params(tnet), self.net_state,
-                           feed_fn(k))
+                sums = fwd(tparams, tstate, feed_fn(k))
                 if self._sync_test:
                     jax.block_until_ready(sums)
                 acc = sums if acc is None else acc + sums

@@ -25,7 +25,11 @@ def main(argv=None) -> int:
         create_main=create_main,
         real_marker="data_batch_1.bin",
         solver="examples/cifar10/cifar10_quick_solver.prototxt",
-        argv=argv)
+        argv=argv,
+        # synthetic separable task reaches >=99% with this recipe in 150
+        # iters (tests/test_convergence.py::test_cifar10_quick_99pct);
+        # reference examples/cifar10 publishes ~75% on real CIFAR-10
+        expect_acc=0.99, assert_min_iter=150)
 
 
 if __name__ == "__main__":

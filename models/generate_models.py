@@ -637,10 +637,13 @@ def inception_v3(batch=32):
     return n
 
 
-def caffenet(batch=256):
+def caffenet(batch=256, name="CaffeNet", classes=1000, head="fc8",
+             head_lr=1):
     """bvlc_reference_caffenet: AlexNet variant with pool-before-norm
-    (reference models/bvlc_reference_caffenet)."""
-    n = NetSpec("CaffeNet")
+    (reference models/bvlc_reference_caffenet). head/classes/head_lr
+    parameterize the classifier for finetuning recipes (the flickr-style
+    net is this body with a fresh fc8_flickr head at 10x lr)."""
+    n = NetSpec(name)
     n.data, n.label = L.Input(ntop=2, input_param=dict(
         shape=[dict(dim=[batch, 3, 227, 227]), dict(dim=[batch])]))
     n.conv1, n.relu1 = conv_relu(n.data, 96, 11, stride=4)
@@ -663,11 +666,31 @@ def caffenet(batch=256):
                            bias_filler=dict(type="constant", value=1))
     n.relu7 = L.ReLU(n.fc7, in_place=True)
     n.drop7 = L.Dropout(n.fc7, dropout_ratio=0.5, in_place=True)
-    n.fc8 = L.InnerProduct(n.fc7, num_output=1000,
-                           weight_filler=dict(type="gaussian", std=0.01),
-                           bias_filler=dict(type="constant"))
-    train_test_tail(n, n.fc8)
+    head_kw = {}
+    if head_lr != 1:
+        # finetuning: the fresh head learns 10x faster than the
+        # pretrained body (reference models/finetune_flickr_style/
+        # train_val.prototxt lr_mult 10/20 on fc8_flickr)
+        head_kw["param"] = [dict(lr_mult=head_lr, decay_mult=1),
+                            dict(lr_mult=2 * head_lr, decay_mult=0)]
+    ip = L.InnerProduct(n.fc7, num_output=classes,
+                        weight_filler=dict(type="gaussian", std=0.01),
+                        bias_filler=dict(type="constant"), **head_kw)
+    setattr(n, head, ip)
+    train_test_tail(n, ip)
     return n
+
+
+def finetune_flickr_style(batch=50):
+    """CaffeNet body + fresh 20-way fc8_flickr head: `caffe train -solver
+    models/finetune_flickr_style/solver.prototxt -weights
+    models/caffenet/<caffenet>.caffemodel` loads every body layer by name
+    and leaves the renamed head at its filler init — the reference's
+    canonical finetuning workflow (reference
+    models/finetune_flickr_style/train_val.prototxt, examples/
+    finetune_flickr_style/readme.md; 20 Flickr style classes)."""
+    return caffenet(batch=batch, name="FlickrStyleCaffeNet", classes=20,
+                    head="fc8_flickr", head_lr=10)
 
 
 def vgg16(batch=64):
@@ -1056,6 +1079,23 @@ weight_decay: 0.0005
 snapshot: 10000
 snapshot_prefix: "models/caffenet/caffenet_train"
 """,
+    "finetune_flickr_style": """# Flickr-style finetuning solver (reference
+# models/finetune_flickr_style/solver.prototxt: lr 10x lower than
+# from-scratch, step decay closer-in, fresh head at lr_mult 10)
+net: "models/finetune_flickr_style/train_val.prototxt"
+test_iter: 100
+test_interval: 1000
+base_lr: 0.001
+lr_policy: "step"
+gamma: 0.1
+stepsize: 20000
+display: 20
+max_iter: 100000
+momentum: 0.9
+weight_decay: 0.0005
+snapshot: 10000
+snapshot_prefix: "models/finetune_flickr_style/finetune_flickr_style"
+""",
     "vgg16": """# VGG-16 solver (reference models/vgg16 recipe class)
 net: "models/vgg16/train_val.prototxt"
 test_iter: 1000
@@ -1171,6 +1211,7 @@ def main():
         "alexnet_owt": alexnet_owt(),
         "inception_v2": inception_v2(),
         "caffenet": caffenet(),
+        "finetune_flickr_style": finetune_flickr_style(),
         "cifar10_quick": cifar10_quick(),
         "googlenet": googlenet(),
         "inception_v3": inception_v3(),

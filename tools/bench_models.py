@@ -42,12 +42,18 @@ SWEEP = {
                       "DGX-1-recipe batch"),
     "resnet50_fp16": ("models/resnet50/solver_fp16.prototxt", 32,
                       "bf16 compute policy (FLOAT16->bf16 mapping)"),
+    "resnet50_b256_fp16": ("models/resnet50/solver_fp16.prototxt", 256,
+                           "north-star config: DGX batch + bf16 storage "
+                           "(docs/mfu_analysis.md)"),
+    "alexnet_fp16": ("models/alexnet/solver_fp16.prototxt", 256,
+                     "headline topology, bf16 storage"),
     "vgg16": ("models/vgg16/solver.prototxt", 32, None),
     "inception_v3": ("models/inception_v3/solver.prototxt", 32, None),
     "cifar10_quick": ("models/cifar10_quick/solver.prototxt", 100, None),
 }
-DEFAULT = ["alexnet", "googlenet", "resnet50", "resnet50_b256",
-           "resnet50_fp16", "vgg16", "inception_v3"]
+DEFAULT = ["alexnet", "alexnet_fp16", "googlenet", "resnet50",
+           "resnet50_b256", "resnet50_fp16", "resnet50_b256_fp16",
+           "vgg16", "inception_v3"]
 _CHILD = os.environ.get("CAFFE_BENCH_MODELS_CHILD")
 
 

@@ -81,21 +81,27 @@ while true; do
           cp tpu_validation.log.partial docs/tpu_validation_r05_partial.log
           evidence="$evidence docs/tpu_validation_r05_partial.log"
         fi
-        committed=1
         if [ -n "$evidence" ]; then
           # The capture (hours, chip-claiming) and the commit (cheap,
           # host-only) fail independently: retry only the commit — e.g. a
           # transient .git/index.lock — never the capture. Pathspec-scoped
-          # so unrelated staged work is not swept in.
+          # so unrelated staged work is not swept in. "nothing to commit"
+          # is not transient: stop retrying immediately.
           for attempt in 1 2 3 4 5; do
             git add -f -- $evidence >> "$LOG" 2>&1
-            if git commit -m "Hardware evidence auto-captured by tunnel watchdog (validation rc=$vrc, zoo sweep rc=$brc)" \
-                -- $evidence >> "$LOG" 2>&1; then
-              committed=0
+            out=$(git commit -m "Hardware evidence auto-captured by tunnel watchdog (validation rc=$vrc, zoo sweep rc=$brc)" \
+                -- $evidence 2>&1)
+            rc=$?
+            echo "$out" >> "$LOG"
+            if [ "$rc" -eq 0 ]; then
               echo "$(date +%H:%M:%S) evidence committed" >> "$LOG"
               break
             fi
-            echo "$(date +%H:%M:%S) commit attempt $attempt failed (or nothing new)" >> "$LOG"
+            case "$out" in *"nothing to commit"*|*"nothing added"*)
+              echo "$(date +%H:%M:%S) evidence unchanged; not retrying" >> "$LOG"
+              break;;
+            esac
+            echo "$(date +%H:%M:%S) commit attempt $attempt failed" >> "$LOG"
             sleep 60
           done
         fi

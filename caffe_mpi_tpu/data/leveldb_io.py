@@ -37,6 +37,7 @@ from __future__ import annotations
 import glob
 import os
 import struct
+import threading
 
 TABLE_MAGIC = 0xDB4775248B80FB57
 RESTART_INTERVAL = 16
@@ -382,16 +383,22 @@ class LevelDBReader:
         self._records = [(k, loc) for k, (s, typ, loc) in sorted(best.items())
                          if typ == TYPE_VALUE]
         self._block_cache: dict[tuple, list] = {}
+        # multi-threaded feeders share one reader; the FIFO eviction's
+        # read-evict-insert is not atomic (two threads popping the same
+        # head key raced to a KeyError in the round-5 thread sweep)
+        self._cache_lock = threading.Lock()
 
     def _block_values(self, ti: int, off: int, size: int) -> list:
         key = (ti, off)
-        vals = self._block_cache.get(key)
+        vals = self._block_cache.get(key)  # lock-free hit path (GIL-atomic)
         if vals is None:
             vals = [v for _k, v in
                     _parse_block(self._tables[ti].read_block(off, size))]
-            if len(self._block_cache) >= self._BLOCK_CACHE:
-                self._block_cache.pop(next(iter(self._block_cache)))
-            self._block_cache[key] = vals
+            with self._cache_lock:
+                while len(self._block_cache) >= self._BLOCK_CACHE:
+                    self._block_cache.pop(next(iter(self._block_cache)),
+                                          None)
+                self._block_cache[key] = vals
         return vals
 
     def _value(self, loc) -> bytes:

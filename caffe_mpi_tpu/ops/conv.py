@@ -24,14 +24,15 @@ from jax import lax
 
 DN = lax.conv_dimension_numbers
 
-# Layout experiment knob (hardware A/B): CAFFE_CONV_LAYOUT=NHWC routes
-# every conv through NHWC/HWIO dimension numbers with transposes at the
-# op edges. The logical blob layout stays NCHW everywhere (Caffe
-# semantics are NCHW-shaped); XLA cancels the back-to-back transposes
-# between consecutive conv/elementwise ops, so this approximates a true
-# NHWC pipeline closely enough to measure whether XLA's TPU layout
-# assignment already saturates the MXU from NCHW graphs (docs/benchmarks
-# records the measurement). Default: NCHW, trusting layout assignment.
+# Layout knob (hardware A/B): CAFFE_CONV_LAYOUT=NHWC routes every conv
+# through NHWC/HWIO dimension numbers with transposes at the op edges.
+# RESOLVED round 5 (docs/mfu_analysis.md): on identical AlexNet graphs
+# the NHWC emulation changes neither XLA-counted flops nor bytes and
+# only adds un-cancelled edge transposes, while the measured MFU sits at
+# the f32 bandwidth-bound roofline ceiling — layout is not the
+# bottleneck, HBM traffic is. Default: NCHW (Caffe's logical layout),
+# trusting XLA's TPU layout assignment for the physical tiling; the
+# knob stays for a live on-chip A/B.
 _NHWC = os.environ.get("CAFFE_CONV_LAYOUT", "").upper() == "NHWC"
 
 

@@ -688,6 +688,17 @@ class Solver:
                     self._current_step())
             self.wait_snapshots()
             return self._write_snapshot(*view)
+        # Settle the live buffers BEFORE dispatching the copies. The
+        # interval snapshot fires right after a step whose execution is
+        # still in flight and whose donated inputs are mid-handoff;
+        # dispatching jnp.copy against that state intermittently ABORTS
+        # inside the runtime (SIGABRT, no Python exception — the round-4/5
+        # suite's 'Fatal Python error', reproduced ~1-in-10 on the
+        # 8-virtual-device CPU client and root-caused to exactly this
+        # call stack; docs/crash_hunt_r5.md). Blocking here costs only
+        # the tail of one step: the copies could not start earlier
+        # anyway, and the device->host gather still runs in the worker.
+        jax.block_until_ready((self.params, self.net_state, self.opt_state))
         copy = lambda t: jax.tree.map(
             lambda a: jnp.copy(a) if isinstance(a, jax.Array) else a, t)
         view = (copy(self.params), copy(self.net_state),

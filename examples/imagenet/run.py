@@ -24,7 +24,16 @@ def main(argv=None) -> int:
         create_main=create_main,
         real_marker="train.txt",
         solver="examples/imagenet/caffenet_solver.prototxt",
-        argv=argv, synthetic_test_iter=3)
+        argv=argv, synthetic_test_iter=3,
+        # CaffeNet sits at chance through the early plateau (measured:
+        # accuracy 0.1, loss ln(10) at iter 100 on the synthetic task —
+        # round-5 CPU run); no run of assert_min_iter length has been
+        # affordable on this 1-core host (~30 s/iter), so the bar is
+        # deliberately a conservative "learning happened at all" check
+        # (3x chance on 10 classes), not a convergence claim: 5000 iters
+        # is ~850 epochs of the synthetic DB, and a net still at 0.1
+        # there is defective. Tighten after a measured TPU-length run.
+        expect_acc=0.3, assert_min_iter=5000)
 
 
 if __name__ == "__main__":

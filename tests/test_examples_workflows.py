@@ -16,6 +16,21 @@ _ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 
 @pytest.mark.slow
+def test_mnist_example_self_asserts(monkeypatch):
+    """The flagship example's success criterion is enforced in-process:
+    run_example parses the final TestAll accuracy and fails below the
+    published threshold (reference examples/mnist/readme.md convention).
+    300 iters is past the documented convergence length (250), so the
+    0.99 bar is ACTIVE in this run, not skipped."""
+    monkeypatch.chdir(_ROOT)
+    spec = importlib.util.spec_from_file_location(
+        "mnist_run", os.path.join(_ROOT, "examples/mnist/run.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main(["-max_iter", "300"]) == 0
+
+
+@pytest.mark.slow
 def test_finetune_example_end_to_end(monkeypatch):
     monkeypatch.chdir(_ROOT)
     spec = importlib.util.spec_from_file_location(

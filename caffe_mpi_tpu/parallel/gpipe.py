@@ -304,13 +304,21 @@ class GPipe:
 
     # ------------------------------------------------------------------
     def train_step(self, params, state, microbatch_feeds: Sequence[dict],
-                   *, rngs: Sequence[jax.Array] | None = None):
+                   *, rngs: Sequence[jax.Array] | None = None,
+                   loss_scale: float = 1.0):
         """One pipelined step over n_micro microbatch feed dicts.
 
         Returns (loss, grads, new_state): loss and grads are means over
         microbatches (iter_size semantics); grads has the structure of the
         OWNED params referenced by the net; new_state is the post-step
-        layer state (microbatches applied in order)."""
+        layer state (microbatches applied in order).
+
+        loss_scale: fp16/bf16 loss scaling (reference global_grad_scale,
+        net.cpp:116-119): the backward seed is scaled so low-precision
+        cotangents don't underflow inside the per-stage vjp; the returned
+        grads are SCALED by loss_scale — the caller unwinds it (the
+        reference unwinds in SGDSolver::Normalize, net.cpp:815-818). The
+        returned loss is unscaled."""
         n_micro = len(microbatch_feeds)
         if n_micro < 1:
             raise ValueError("need at least one microbatch")
@@ -347,7 +355,7 @@ class GPipe:
         # producing stage's device)
         ct_env: list[dict[str, jax.Array]] = [dict() for _ in range(n_micro)]
         grads: dict[str, dict[str, jax.Array]] = {}
-        one = jnp.ones((), jnp.float32)
+        ct_loss_seed = jnp.float32(loss_scale)
         for t in range(S + n_micro - 2, -1, -1):
             for s in range(min(t, S - 1), -1, -1):
                 m = t - s
@@ -365,7 +373,7 @@ class GPipe:
                     ct_out[b] = jax.device_put(ct, dev[s])
                 ct_params, ct_in = self._bwd[s](
                     stage_params[s], st_in, feeds, env_in, rng,
-                    ct_out, jax.device_put(one, dev[s]))
+                    ct_out, jax.device_put(ct_loss_seed, dev[s]))
                 for lname, tree in ct_params.items():
                     g = grads.setdefault(lname, {})
                     # accumulate on the owner's device: shared params

@@ -181,9 +181,6 @@ class Solver:
                 raise ValueError(
                     "iter_size > 1 under gpipe is redundant: micro_batches "
                     "already accumulate with iter_size semantics")
-            if sp.global_grad_scale and sp.global_grad_scale != 1.0:
-                raise ValueError("global_grad_scale (fp16 loss scaling) is "
-                                 "not plumbed through gpipe stages yet")
             if grad_transform is not None:
                 raise ValueError("grad_transform hooks into the SPMD step; "
                                  "unsupported under gpipe")
@@ -458,20 +455,26 @@ class Solver:
         micro = [feed_fn(self.iter * M + m) for m in range(M)]
         rng = jax.random.fold_in(self.base_rng, self.iter + 1)
         rngs = list(jax.random.split(rng, M))
+        # global_grad_scale: seed the backward scaled (low-precision
+        # cotangents must not underflow in the stage vjps), unwind in the
+        # per-stage update via gscale (net.cpp:116-119, 815-818)
+        lscale = self.sp.global_grad_scale or 1.0
         loss, grads, self.net_state = gp.train_step(
-            self.params, self.net_state, micro, rngs=rngs)
+            self.params, self.net_state, micro, rngs=rngs,
+            loss_scale=lscale)
 
         if self._gpipe_updates is None:
             self._gpipe_updates = self._build_gpipe_update()
             self._gpipe_sqnorm = jax.jit(lambda g: sum(
                 jnp.sum(jnp.square(x)).astype(jnp.float32)
                 for x in jax.tree.leaves(g)))
-        gscale = 1.0
+        gscale = 1.0 / lscale  # unwind the loss scaling on the grads
         if self.sp.clip_gradients > 0:
             # the clip norm spans ALL stages: per-stage partial sums stay
             # on their devices, hop to stage 0, and ONE float() pays the
             # only host sync of the iteration (never float() in a loop —
-            # each call is a tunnel RTT)
+            # each call is a tunnel RTT). grads are loss-scaled here, so
+            # the norm unwinds by 1/lscale before the clip comparison.
             parts = []
             for s in range(gp.n_stages):
                 gs = {ln: grads[ln]
@@ -479,9 +482,9 @@ class Solver:
                 if gs:
                     parts.append(jax.device_put(self._gpipe_sqnorm(gs),
                                                 gp.devices[0]))
-            gnorm = float(sum(parts)) ** 0.5
+            gnorm = float(sum(parts)) ** 0.5 / lscale
             if gnorm > self.sp.clip_gradients:
-                gscale = self.sp.clip_gradients / gnorm
+                gscale *= self.sp.clip_gradients / gnorm
 
         it = jnp.int32(self.iter)
         rate = lr_policy.learning_rate(self.sp, it)

@@ -23,6 +23,8 @@ CASES = [
     ("inception_v2", f"{REF}/inception_v2/train_val.prototxt"),
     ("alexnet_bn", f"{REF}/alexnet_bn/train_val.prototxt"),
     ("cifar10_nv", f"{REF}/cifar10_nv/cifar10_nv_train_test.prototxt"),
+    ("finetune_flickr_style",
+     f"{REF}/finetune_flickr_style/train_val.prototxt"),
 ]
 
 

@@ -43,12 +43,25 @@ def build_db(workdir: str, n: int, shape=(3, 256, 256)) -> tuple[str, str]:
     mean = os.path.join(workdir, f"e2e_mean_{n}.binaryproto")
     if os.path.isdir(db) and os.path.exists(mean):
         return db, mean
-    imgs, labels = synthetic_clusters(n, shape, seed=7, classes=10)
-    write_lmdb(db, ((f"{i:08d}".encode(), encode_datum(imgs[i],
-                                                       int(labels[i])))
-                    for i in range(n)))
-    m = imgs.astype(np.float64).mean(axis=0).astype(np.float32)
-    save_blob_binaryproto(mean, m[None])
+
+    # chunked generation (same reason as examples/imagenet/
+    # create_imagenet.py): one 1024-record draw at 3x256x256 peaks at
+    # multiple GB of transient int arrays on this host
+    mean_acc = np.zeros(shape, np.float64)
+
+    def records():
+        chunk = 64
+        for lo in range(0, n, chunk):
+            k = min(chunk, n - lo)
+            imgs, labels = synthetic_clusters(k, shape, seed=7 + lo,
+                                              classes=10)
+            mean_acc[...] += imgs.sum(axis=0, dtype=np.float64)
+            for i in range(k):
+                yield (f"{lo + i:08d}".encode(),
+                       encode_datum(imgs[i], int(labels[i])))
+
+    write_lmdb(db, records())
+    save_blob_binaryproto(mean, (mean_acc / n).astype(np.float32)[None])
     return db, mean
 
 
@@ -98,13 +111,18 @@ def main() -> int:
     feeder = _build_feeders(solver.net, "TRAIN")
     assert feeder is not None, "Data layer did not produce a feeder"
 
-    warmup = 3
-    solver.step(warmup, feeder)
-    jax.block_until_ready(solver.params)
-    t0 = time.perf_counter()
-    solver.step(args.iters, feeder)
-    jax.block_until_ready(solver.params)
-    dt = time.perf_counter() - t0
+    try:
+        warmup = 3
+        solver.step(warmup, feeder)
+        jax.block_until_ready(solver.params)
+        t0 = time.perf_counter()
+        solver.step(args.iters, feeder)
+        jax.block_until_ready(solver.params)
+        dt = time.perf_counter() - t0
+    finally:
+        # failure paths must not leave prefetch workers holding the DB
+        # (this runs inside tpu_validation's watched subprocess)
+        feeder.close()
     img_s = args.batch * args.iters / dt
 
     device = jax.devices()[0]
@@ -115,7 +133,6 @@ def main() -> int:
           f"{args.iters} iters, {device.device_kind}, MFU {mfu}) — "
           "full host pipeline: LMDB read -> decode -> transform/staging "
           "-> device feed -> jitted train step")
-    feeder.close()
     return 0
 
 

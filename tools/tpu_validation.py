@@ -50,7 +50,9 @@ def run(name, cmd, timeout, log):
     for line in tail:
         log.write(f"    {line}\n")
     log.flush()
-    print("\n".join(tail[-6:]))
+    # console mirrors the whole tail: stdout (where stage headlines
+    # print) must not be buried under stderr chatter here either
+    print("\n".join(tail))
     print(f"=== {name}: {status} ({dt:.0f}s)\n", flush=True)
     return ok
 
@@ -115,6 +117,14 @@ for causal in (False, True):
                 [py, "-m", "caffe_mpi_tpu.tools.cli", "time",
                  "-model", "models/alexnet/train_val.prototxt",
                  "-phase", "TRAIN", "-iterations", "10"],
+                600, log)
+            # inference throughput vs the reference's K40 test baseline
+            # (50k val images in 60.7 s = 824 img/s,
+            # docs/performance_hardware.md:17-24)
+            run("caffe-time-alexnet-test",
+                [py, "-m", "caffe_mpi_tpu.tools.cli", "time",
+                 "-model", "models/alexnet/train_val.prototxt",
+                 "-phase", "TEST", "-iterations", "10"],
                 600, log)
             run("train-gpu-all",
                 [py, "-m", "caffe_mpi_tpu.tools.cli", "train",

@@ -93,8 +93,6 @@ def probe():
 
 def run_bench():
     import jax
-    import jax.numpy as jnp
-    import numpy as np
 
     # warm-cacheable compiles: the retry child + later runs skip the
     # ~20-40s AlexNet-step compile
@@ -115,24 +113,14 @@ def run_bench():
     sp.display = 0
     sp.snapshot = 0
     sp.test_interval = 0
+    from caffe_mpi_tpu.utils.model_shapes import input_shapes, synthetic_feeds
     npar = NetParameter.from_file(os.path.join(_ROOT, sp.net))
-    shapes = {}
-    for l in npar.layer:
-        if l.type == "Input":
-            for top, shp in zip(l.top, l.input_param.shape):
-                shp.dim[0] = BATCH
-                shapes[top] = list(shp.dim)
+    shapes = input_shapes(npar, batch=BATCH)
     sp.net = ""
     sp.net_param = npar
     solver = Solver(sp, model_dir=_ROOT)
 
-    r = np.random.RandomState(0)
-    feeds = {}
-    for top, dims in shapes.items():
-        if top == "label":
-            feeds[top] = jnp.asarray(r.randint(0, 1000, dims[0]))
-        else:
-            feeds[top] = jnp.asarray(r.randn(*dims).astype(np.float32))
+    feeds = synthetic_feeds(shapes)
     feed_fn = lambda it: feeds
 
     # warmup (compile + first steps)

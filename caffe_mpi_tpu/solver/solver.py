@@ -189,7 +189,12 @@ class Solver:
             self.gpipe = GPipe(self.net, cfg.get("stages"),
                                boundaries=cfg.get("boundaries"),
                                devices=cfg.get("devices"))
-            self._gpipe_updates = None  # single jit, built lazily
+            self._gpipe_update = None  # single jit, built lazily
+            # static stage->owned-param-layers partition (ownership never
+            # changes after placement; don't rescan every iteration)
+            self._gpipe_owned = [
+                self.gpipe.owned_param_layers(s, self.params)
+                for s in range(self.gpipe.n_stages)]
             self._place_params_opt()
         self.iter = 0
         # nets with host-callback layers (DetectNetTransformation) re-enter
@@ -463,8 +468,8 @@ class Solver:
             self.params, self.net_state, micro, rngs=rngs,
             loss_scale=lscale)
 
-        if self._gpipe_updates is None:
-            self._gpipe_updates = self._build_gpipe_update()
+        if self._gpipe_update is None:
+            self._gpipe_update = self._build_gpipe_update()
             self._gpipe_sqnorm = jax.jit(lambda g: sum(
                 jnp.sum(jnp.square(x)).astype(jnp.float32)
                 for x in jax.tree.leaves(g)))
@@ -476,9 +481,8 @@ class Solver:
             # each call is a tunnel RTT). grads are loss-scaled here, so
             # the norm unwinds by 1/lscale before the clip comparison.
             parts = []
-            for s in range(gp.n_stages):
-                gs = {ln: grads[ln]
-                      for ln in gp.owned_param_layers(s, grads)}
+            for owned in self._gpipe_owned:
+                gs = {ln: grads[ln] for ln in owned if ln in grads}
                 if gs:
                     parts.append(jax.device_put(self._gpipe_sqnorm(gs),
                                                 gp.devices[0]))
@@ -489,16 +493,15 @@ class Solver:
         it = jnp.int32(self.iter)
         rate = lr_policy.learning_rate(self.sp, it)
         mom = lr_policy.momentum(self.sp, it)
-        upd = self._gpipe_updates
-        for s in range(gp.n_stages):
-            owned = gp.owned_param_layers(s, self.params)
+        upd = self._gpipe_update
+        gscale_arr = jnp.float32(gscale)
+        for owned in self._gpipe_owned:
             if not owned:
                 continue
             p_s = {ln: self.params[ln] for ln in owned}
             g_s = {ln: grads[ln] for ln in owned if ln in grads}
             o_s = {ln: self.opt_state[ln] for ln in owned}
-            new_p, new_o = upd(p_s, g_s, o_s, rate, mom, it,
-                               jnp.float32(gscale))
+            new_p, new_o = upd(p_s, g_s, o_s, rate, mom, it, gscale_arr)
             self.params.update(new_p)
             self.opt_state.update(new_o)
         return loss, rate

@@ -1,0 +1,43 @@
+"""Input-layer shape + synthetic-feed helpers shared by the bench tools
+(bench.py, tools/bench_models.py, tools/mfu_analysis.py) — one definition
+of "rewrite the Input batch dim and build matching feeds" instead of three
+drifting copies."""
+
+from __future__ import annotations
+
+
+def input_shapes(npar, batch: int | None = None,
+                 train_only: bool = True) -> dict[str, list[int]]:
+    """{top: dims} for the net's Input layers. batch, when given, REWRITES
+    the leading dim in-place (callers re-use the mutated NetParameter as
+    the net definition). train_only skips TEST-phase-gated Input layers so
+    the batch override and the feeds track the TRAIN net."""
+    shapes: dict[str, list[int]] = {}
+    for l in npar.layer:
+        if l.type != "Input":
+            continue
+        if train_only and any(str(getattr(r, "phase", "")) == "TEST"
+                              for r in (l.include or [])):
+            continue
+        for top, shp in zip(l.top, l.input_param.shape):
+            if batch:
+                shp.dim[0] = batch
+            shapes[top] = list(shp.dim)
+    return shapes
+
+
+def synthetic_feeds(shapes: dict[str, list[int]], n_classes: int = 1000,
+                    seed: int = 0) -> dict:
+    """Random on-device feeds matching input_shapes() output; 'label' tops
+    get class ids in [0, n_classes)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    r = np.random.RandomState(seed)
+    feeds = {}
+    for top, dims in shapes.items():
+        if top == "label":
+            feeds[top] = jnp.asarray(r.randint(0, n_classes, dims[0]))
+        else:
+            feeds[top] = jnp.asarray(r.randn(*dims).astype(np.float32))
+    return feeds

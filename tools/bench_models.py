@@ -59,8 +59,6 @@ _CHILD = os.environ.get("CAFFE_BENCH_MODELS_CHILD")
 
 def bench_one(key: str) -> dict:
     import jax
-    import jax.numpy as jnp
-    import numpy as np
 
     from caffe_mpi_tpu.proto import NetParameter, SolverParameter
     from caffe_mpi_tpu.solver import Solver
@@ -74,19 +72,9 @@ def bench_one(key: str) -> dict:
     sp.display = 0
     sp.snapshot = 0
     sp.test_interval = 0
+    from caffe_mpi_tpu.utils.model_shapes import input_shapes, synthetic_feeds
     npar = NetParameter.from_file(os.path.join(_ROOT, sp.net))
-    shapes = {}
-    for l in npar.layer:
-        if l.type == "Input":
-            if any(str(getattr(r, "phase", "")) == "TEST"
-                   for r in (l.include or [])):
-                continue  # batch override + feeds track the TRAIN net
-            for top, shp in zip(l.top, l.input_param.shape):
-                dims = list(shp.dim)
-                if batch:
-                    dims[0] = batch
-                    shp.dim[0] = batch
-                shapes[top] = dims
+    shapes = input_shapes(npar, batch=batch)
     sp.net = ""
     sp.net_param = npar
     solver = Solver(sp, model_dir=_ROOT)
@@ -100,13 +88,7 @@ def bench_one(key: str) -> dict:
         if l.type == "InnerProduct" and l.top and \
                 l.top[0] in loss_bottoms and l.inner_product_param.num_output:
             n_classes = l.inner_product_param.num_output
-    r = np.random.RandomState(0)
-    feeds = {}
-    for top, dims in shapes.items():
-        if top == "label":
-            feeds[top] = jnp.asarray(r.randint(0, n_classes, dims[0]))
-        else:
-            feeds[top] = jnp.asarray(r.randn(*dims).astype(np.float32))
+    feeds = synthetic_feeds(shapes, n_classes=n_classes)
     feed_fn = lambda it: feeds
 
     iters, warmup = 20, 3

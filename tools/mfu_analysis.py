@@ -67,18 +67,15 @@ def build_step(solver_path: str, batch: int):
     from caffe_mpi_tpu.proto import NetParameter, SolverParameter
     from caffe_mpi_tpu.solver import Solver
 
+    from caffe_mpi_tpu.utils.model_shapes import input_shapes
+
     sp = SolverParameter.from_file(os.path.join(_ROOT, solver_path))
     sp.max_iter = 10**9
     sp.display = 0
     sp.snapshot = 0
     sp.test_interval = 0
     npar = NetParameter.from_file(os.path.join(_ROOT, sp.net))
-    shapes = {}
-    for l in npar.layer:
-        if l.type == "Input":
-            for top, shp in zip(l.top, l.input_param.shape):
-                shp.dim[0] = batch
-                shapes[top] = list(shp.dim)
+    shapes = input_shapes(npar, batch=batch)
     sp.net = ""
     sp.net_param = npar
     solver = Solver(sp, model_dir=_ROOT)

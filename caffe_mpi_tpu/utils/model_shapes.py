@@ -19,7 +19,12 @@ def input_shapes(npar, batch: int | None = None,
         if train_only and any(str(getattr(r, "phase", "")) == "TEST"
                               for r in (l.include or [])):
             continue
-        for top, shp in zip(l.top, l.input_param.shape):
+        decls = list(l.input_param.shape)
+        if len(decls) == 1 and len(l.top) > 1:
+            # one shape block broadcasts to every top, matching
+            # InputLayer.setup (layers/data_layers.py)
+            decls = decls * len(l.top)
+        for top, shp in zip(l.top, decls):
             if batch:
                 shp.dim[0] = batch
             shapes[top] = list(shp.dim)

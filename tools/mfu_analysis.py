@@ -63,7 +63,6 @@ def build_step(solver_path: str, batch: int):
     """Build the Solver and return (lowered-args, jitted step, net)."""
     import jax
     import jax.numpy as jnp
-    import numpy as np
     from caffe_mpi_tpu.proto import NetParameter, SolverParameter
     from caffe_mpi_tpu.solver import Solver
 

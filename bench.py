@@ -39,26 +39,31 @@ TOTAL_BUDGET_S = 450        # hard cap: probe + compile (~40s) + 23 steps
 _IS_CHILD = os.environ.get("CAFFE_TPU_BENCH_CHILD") == "1"
 
 # debug/staged knobs (the headline metric is always AlexNet f32 batch 256,
-# 20 iters; overriding any knob renames the metric so an alternate line
-# can't be mistaken for it). Staged configs for a hardware window
-# (docs/mfu_analysis.md): CAFFE_BENCH_DTYPE=bf16 switches to the fp16
-# prototxt variant (FLOAT16 -> bf16 storage, f32 master weights);
+# 20 iters, step_chunk 10; overriding any knob renames the metric so an
+# alternate line can't be mistaken for it). Staged configs for a hardware
+# window (docs/mfu_analysis.md): CAFFE_BENCH_DTYPE=bf16 switches to the
+# fp16 prototxt variant (FLOAT16 -> bf16 storage, f32 master weights);
 # CAFFE_BENCH_MODEL=resnet50 benches the north-star topology.
+# CAFFE_BENCH_STEP_CHUNK: iterations fused into one lax.scan dispatch
+# (solver step_chunk; 20 timed iters at K=10 = 2 host dispatches instead
+# of 20 — over the tunnel, 2 RTTs instead of 20). Set 1 for the classic
+# per-iteration dispatch mode.
 BATCH = int(os.environ.get("CAFFE_BENCH_BATCH", 256))
 WARMUP = int(os.environ.get("CAFFE_BENCH_WARMUP", 3))
 ITERS = int(os.environ.get("CAFFE_BENCH_ITERS", 20))
 MODEL = os.environ.get("CAFFE_BENCH_MODEL", "alexnet")
 DTYPE = os.environ.get("CAFFE_BENCH_DTYPE", "f32")
+STEP_CHUNK = max(int(os.environ.get("CAFFE_BENCH_STEP_CHUNK", 10)), 1)
 _SOLVERS = {
     ("alexnet", "f32"): "models/alexnet/solver.prototxt",
     ("alexnet", "bf16"): "models/alexnet/solver_fp16.prototxt",
     ("resnet50", "f32"): "models/resnet50/solver.prototxt",
     ("resnet50", "bf16"): "models/resnet50/solver_fp16.prototxt",
 }
-_IS_DEBUG = (BATCH, ITERS, WARMUP, MODEL, DTYPE) != (256, 20, 3,
-                                                     "alexnet", "f32")
+_IS_DEBUG = (BATCH, ITERS, WARMUP, MODEL, DTYPE,
+             STEP_CHUNK) != (256, 20, 3, "alexnet", "f32", 10)
 METRIC = ("alexnet_b256_train_img_per_s_1chip" if not _IS_DEBUG
-          else f"debug_{MODEL}_{DTYPE}_b{BATCH}_i{ITERS}"
+          else f"debug_{MODEL}_{DTYPE}_b{BATCH}_i{ITERS}_k{STEP_CHUNK}"
                "_train_img_per_s_1chip")
 
 
@@ -113,6 +118,7 @@ def run_bench():
     sp.display = 0
     sp.snapshot = 0
     sp.test_interval = 0
+    sp.step_chunk = STEP_CHUNK
     from caffe_mpi_tpu.utils.model_shapes import input_shapes, synthetic_feeds
     npar = NetParameter.from_file(os.path.join(_ROOT, sp.net))
     shapes = input_shapes(npar, batch=BATCH)
@@ -120,17 +126,23 @@ def run_bench():
     sp.net_param = npar
     solver = Solver(sp, model_dir=_ROOT)
 
-    feeds = synthetic_feeds(shapes)
+    feeds = synthetic_feeds(shapes, npar=npar)
     feed_fn = lambda it: feeds
 
-    # warmup (compile + first steps)
-    solver.step(WARMUP, feed_fn)
+    # warmup (compile + first steps). With K-step fusion active, warm at
+    # least one FULL chunk so the timed region reuses the compiled scan
+    # program instead of compiling it on the clock.
+    warmup = max(WARMUP, sp.step_chunk if sp.step_chunk > 1 else 0)
+    solver.step(warmup, feed_fn)
     jax.block_until_ready(solver.params)
 
+    d0, s0 = solver.dispatch_count, solver.host_sync_count
     t0 = time.perf_counter()
     solver.step(ITERS, feed_fn)
     jax.block_until_ready(solver.params)
     dt = time.perf_counter() - t0
+    dispatches = solver.dispatch_count - d0
+    host_syncs = solver.host_sync_count - s0
 
     img_s = BATCH * ITERS / dt
     flops_img = train_flops_per_image(solver.net)
@@ -141,6 +153,15 @@ def run_bench():
         "device": device.device_kind,
         "model_tflops_per_s": round(achieved / 1e12, 2),
         "mfu": round(achieved / peak, 4) if peak else None,
+        # host dispatches per 100 training iterations: ~100 in classic
+        # mode, ~100/K + host-event syncs with K-step fusion. Platform-
+        # independent, so the dispatch-reduction win is visible from the
+        # CPU fallback even when the tunnel is down.
+        "step_chunk": sp.step_chunk,
+        "dispatches_per_100_iters": round(dispatches * 100 / ITERS, 1),
+        # 0 in the headline config (display off): the timed region never
+        # blocks on the device between chunks
+        "host_syncs": host_syncs,
     }
     return round(img_s, 1), round(img_s / BASELINE_IMG_S, 2), extra
 

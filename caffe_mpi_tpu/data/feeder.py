@@ -279,6 +279,82 @@ class Feeder:
         self.pool.shutdown(wait=False, cancel_futures=True)
 
 
+class DeviceFeedQueue:
+    """Double-buffered DEVICE-resident super-batch queue — the feed side
+    of the K-step fused train loop (solver.step_chunk > 1).
+
+    The host-side pipeline above (Feeder) overlaps batch ASSEMBLY with
+    the train step but still hands the solver one host batch per
+    iteration, costing one dispatch each. This queue extends the
+    lookahead to the device: `get(it0, k)` returns a stacked feeds
+    pytree with leaves [k, iter_size, ...] already `device_put` (or
+    mesh-sharded), and a single worker thread assembles + transfers the
+    NEXT super-batch (the `hint`) while the current k-iteration scan
+    chunk runs on the chip — so host->HBM transfer hides behind compute,
+    the way the reference hides its NCCL allreduce behind backprop
+    (parallel.cpp:166-169), but for the input stream.
+
+    Super-batches are pure functions of (it0, k) — the underlying
+    feed_fn is indexed (Feeder's deterministic record striping) — so a
+    mispredicted hint is dropped and rebuilt with no correctness cost.
+    """
+
+    def __init__(self, feed_fn, *, iter_size: int = 1, place=None):
+        """place: optional callable(stacked_pytree) -> device pytree
+        (e.g. MeshPlan.shard_feeds at batch_axis=2); default is a plain
+        jax.device_put."""
+        self.feed_fn = feed_fn
+        self.iter_size = max(iter_size, 1)
+        self.place = place
+        self._pool = ThreadPoolExecutor(max_workers=1,
+                                        thread_name_prefix="device-feed")
+        self._pending: dict[tuple[int, int], Future] = {}
+
+    def _build(self, it0: int, k: int):
+        import jax
+        import jax.numpy as jnp
+        isz = self.iter_size
+        micros = [self.feed_fn(m)
+                  for m in range(it0 * isz, (it0 + k) * isz)]
+
+        def stack(*leaves):
+            if all(isinstance(x, np.ndarray) for x in leaves):
+                arr = np.stack(leaves)  # one host copy, then one transfer
+            else:
+                # device-resident feeds (synthetic benches): stack on
+                # device, never pulling them back to host
+                arr = jnp.stack([jnp.asarray(x) for x in leaves])
+            return arr.reshape((k, isz) + arr.shape[1:])
+
+        tree = jax.tree.map(stack, *micros)
+        if self.place is not None:
+            return self.place(tree)
+        return jax.device_put(tree)
+
+    def get(self, it0: int, k: int, hint: tuple[int, int] | None = None):
+        """Super-batch for iterations [it0, it0+k); schedules `hint`
+        (the next chunk's (it0, k)) on the worker before blocking."""
+        fut = self._pending.pop((it0, k), None)
+        if fut is None:
+            fut = self._pool.submit(self._build, it0, k)
+        if hint is not None and hint != (it0, k) and hint not in self._pending:
+            self._pending[hint] = self._pool.submit(self._build, *hint)
+        feeds = fut.result()
+        # drop stale prefetches (resume/seek or a schedule change): they
+        # are pure functions of their indices, rebuild-on-demand is safe
+        for key in [key for key in self._pending if key != hint]:
+            dropped = self._pending.pop(key)
+            if not dropped.cancel():
+                dropped.add_done_callback(Feeder._log_abandoned)
+        return feeds
+
+    def close(self) -> None:
+        for fut in self._pending.values():
+            fut.cancel()
+        self._pending.clear()
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+
 def feeder_from_layer(lp, phase: str, *, rank: int = 0, world: int = 1,
                       model_dir: str = "",
                       device_transform: bool = False) -> Feeder:

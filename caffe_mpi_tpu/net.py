@@ -199,6 +199,14 @@ class Net:
 
     # ------------------------------------------------------------------
     def _divide_batch(self, lp, divisor: int) -> None:
+        """Split a prototxt GLOBAL batch into per-replica/micro batches
+        (reference divide_batch_size, parallel.cpp:295-348). Indivisible
+        batches RAISE instead of rounding up with a warning: a rounded
+        micro-batch silently changes the effective global batch — and so
+        the optimization trajectory — which under `-gpipe` the user never
+        asked for (the reference's round-up applies to its DP replica
+        case, parallel.cpp:284-293, where the feed is re-striped; here
+        the micro-batches ARE the accumulation schedule)."""
         if lp.type == "Input":
             # Input nets (synthetic / deploy): the leading dim of every
             # declared shape is the batch — divide it like a data layer's
@@ -209,19 +217,24 @@ class Net:
                     if shape.dim:
                         b = shape.dim[0]
                         if b % divisor:
-                            log.warning(
-                                "layer %s: input batch %d not divisible by "
-                                "%d; rounding up", lp.name, b, divisor)
-                        shape.dim[0] = max(1, (b + divisor - 1) // divisor)
+                            self._reject_indivisible(lp, b, divisor)
+                        shape.dim[0] = max(1, b // divisor)
             return
         p = lp.data_param if lp.type == "Data" else lp.image_data_param
         if p and p.batch_size:
             if p.batch_size % divisor:
-                log.warning(
-                    "layer %s: batch_size %d not divisible by %d replicas; "
-                    "rounding up (reference parallel.cpp:284-293)",
-                    lp.name, p.batch_size, divisor)
-            p.batch_size = max(1, (p.batch_size + divisor - 1) // divisor)
+                self._reject_indivisible(lp, p.batch_size, divisor)
+            p.batch_size = max(1, p.batch_size // divisor)
+
+    @staticmethod
+    def _reject_indivisible(lp, batch: int, divisor: int):
+        micro = (batch + divisor - 1) // divisor
+        raise ValueError(
+            f"layer {lp.name!r}: global batch {batch} is not divisible by "
+            f"{divisor} (micro-batches x replicas); rounding up would "
+            f"train at an effective global batch of {micro * divisor}, "
+            f"not the configured {batch}. Use a divisible batch_size or "
+            f"adjust -gpipe/-gpipe_micro.")
 
     def bind_mesh(self, mesh_plan) -> None:
         """Hand every layer the active MeshPlan (reference analogue: the

@@ -53,6 +53,16 @@ def learning_rate(p: SolverParameter, it: jnp.ndarray) -> jnp.ndarray:
     return rate.astype(jnp.float32)
 
 
+def schedule(p: SolverParameter, it: jnp.ndarray
+             ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(lr, momentum) at iteration `it` — both pure jnp functions of a
+    traced scalar, so the whole schedule evaluates INSIDE a jitted step
+    and inside the K-step `lax.scan` train loop (the carried iteration
+    counter feeds straight in; no host round-trip, no recompiles as the
+    iteration advances)."""
+    return learning_rate(p, it), momentum(p, it)
+
+
 def momentum(p: SolverParameter, it: jnp.ndarray) -> jnp.ndarray:
     """momentum(iter) as a traced f32 scalar."""
     itf = it.astype(jnp.float32)

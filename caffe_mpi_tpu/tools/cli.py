@@ -61,6 +61,13 @@ def _parser() -> argparse.ArgumentParser:
                            help="override solver test_iter (0 = prototxt)")),
     ]:
         p.add_argument(f"-{flag}", f"--{flag}", **kw)
+    p.add_argument("-step_chunk", "--step_chunk", "--step-chunk",
+                   dest="step_chunk", type=int, default=0,
+                   help="fuse K iterations into ONE on-device lax.scan "
+                   "dispatch (train only; overrides solver step_chunk; "
+                   "0 = prototxt value, which defaults to 1). Chunks "
+                   "auto-align to display/test_interval/snapshot "
+                   "boundaries, so observable behavior is unchanged")
     return p
 
 
@@ -94,15 +101,16 @@ def _synthetic_feed(net, seed=0):
     [0, input_dim); the target bottom of a classification loss/accuracy
     gets class ids."""
     import jax.numpy as jnp
+    from ..utils.model_shapes import _CLASSIFICATION_CONSUMERS
     r = np.random.RandomState(seed)
     int_range: dict[str, int] = {}
     for layer in net.layers:
         lp = layer.lp
         if lp.type == "Embed" and lp.bottom:
             int_range[lp.bottom[0]] = lp.embed_param.input_dim
-        elif lp.type in ("SoftmaxWithLoss", "Accuracy",
-                         "InfogainLoss", "MultinomialLogisticLoss") \
-                and len(lp.bottom) > 1:
+        elif lp.type in _CLASSIFICATION_CONSUMERS and len(lp.bottom) > 1:
+            # one consumer table shared with utils.model_shapes.label_tops
+            # so the two integer-feed detectors cannot drift
             int_range.setdefault(lp.bottom[1], 10)
     feeds = {}
     for key, (shape, kind) in net.feed_specs.items():
@@ -153,6 +161,8 @@ def cmd_train(args) -> int:
         sp.max_iter = args.max_iter
     if args.test_iter:
         sp.test_iter = [args.test_iter] * max(len(sp.test_iter), 1)
+    if args.step_chunk:
+        sp.step_chunk = args.step_chunk
     model_dir = os.path.dirname(os.path.abspath(args.solver)) \
         if not (sp.net and os.path.exists(sp.net)) else ""
     gpipe_cfg = None
@@ -242,8 +252,9 @@ def cmd_train(args) -> int:
             # (solver.cpp:402-407)
     finally:
         # async interval writes must land even when training raises —
-        # a half-written checkpoint is worse than a slow exit
-        solver.wait_snapshots()
+        # a half-written checkpoint is worse than a slow exit — and the
+        # fused-mode feed queue's worker thread must not outlive the run
+        solver.close()
     elapsed = time.time() - t0
     imgs = (solver.iter - start_iter) * solver._batch_images() \
         * max(sp.iter_size, 1) * max(solver._gpipe_micro, 1)

@@ -5,6 +5,13 @@ drifting copies."""
 
 from __future__ import annotations
 
+# loss/metric layers whose SECOND bottom is an integer class-id vector
+# (reference softmax_loss_layer.cpp etc.: label blob of shape [N])
+_CLASSIFICATION_CONSUMERS = frozenset((
+    "SoftmaxWithLoss", "Accuracy", "MultinomialLogisticLoss",
+    "InfogainLoss", "HingeLoss",
+))
+
 
 def input_shapes(npar, batch: int | None = None,
                  train_only: bool = True) -> dict[str, list[int]]:
@@ -31,17 +38,36 @@ def input_shapes(npar, batch: int | None = None,
     return shapes
 
 
+def label_tops(npar, shapes: dict[str, list[int]]) -> set[str]:
+    """Tops that must be fed INTEGER class ids, detected structurally: a
+    1-D blob consumed as the label bottom (bottom[1]) of a classification
+    loss/metric layer. Name-independent — a net whose label top is called
+    'target' or 'y' gets integer feeds too (ADVICE r5: the old literal
+    'label' key match silently fed floats into integer-label losses)."""
+    out = set()
+    for l in npar.layer:
+        if l.type in _CLASSIFICATION_CONSUMERS and len(l.bottom) > 1:
+            b = l.bottom[1]
+            if b in shapes and len(shapes[b]) == 1:
+                out.add(b)
+    return out
+
+
 def synthetic_feeds(shapes: dict[str, list[int]], n_classes: int = 1000,
-                    seed: int = 0) -> dict:
-    """Random on-device feeds matching input_shapes() output; 'label' tops
-    get class ids in [0, n_classes)."""
+                    seed: int = 0, npar=None) -> dict:
+    """Random on-device feeds matching input_shapes() output. Integer
+    class-id feeds are chosen by CONSUMER when `npar` is given
+    (label_tops above); without a net to inspect, any 1-D top is treated
+    as a label vector — both structural, neither keyed on a blob name."""
     import jax.numpy as jnp
     import numpy as np
 
+    ints = (label_tops(npar, shapes) if npar is not None
+            else {t for t, dims in shapes.items() if len(dims) == 1})
     r = np.random.RandomState(seed)
     feeds = {}
     for top, dims in shapes.items():
-        if top == "label":
+        if top in ints:
             feeds[top] = jnp.asarray(r.randint(0, n_classes, dims[0]))
         else:
             feeds[top] = jnp.asarray(r.randn(*dims).astype(np.float32))

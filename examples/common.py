@@ -107,8 +107,17 @@ def run_example(here: str, artifacts: list[str], create_main,
             print(f"(short run: {ran} < {assert_min_iter} iters — final "
                   f"synthetic accuracy {accs[-1]:.4f}, threshold "
                   f"{expect_acc} not enforced)")
+        elif ran >= assert_min_iter:
+            # a run long enough that the threshold WOULD be enforced
+            # produced zero accuracy records: the evaluation never ran
+            # (test_interval/test net misconfigured, or the score-capture
+            # hook broke). Passing silently here would turn the example's
+            # convergence guarantee into a no-op — fail instead.
+            print(f"FAILED self-assert: no test evaluation ran in {ran} "
+                  f"iters (expected a final accuracy >= {expect_acc}); "
+                  "check test_interval / test nets")
+            return 1
         else:
             print(f"self-assert: no test evaluation ran in {ran} iters "
-                  "(solver has no test_interval/test nets?); accuracy "
-                  "threshold not checked")
+                  "(short run; accuracy threshold not checked)")
     return rc

@@ -1,0 +1,372 @@
+"""K-step fused training (solver step_chunk > 1): one jitted lax.scan
+program runs K full iterations over a device-resident super-batch, one
+host dispatch per chunk instead of per iteration.
+
+The contract under test: `step_chunk=K` is an EXECUTION-SCHEDULE knob,
+not a semantics knob — params, optimizer state, per-iteration losses,
+LR schedule evaluation, event timing (display/test/snapshot), and
+snapshot/resume trajectories must all match the classic K=1 path within
+f32 tolerance. Covers the ISSUE-1 acceptance matrix: chunk boundaries
+straddling display/test_interval/snapshot, lr_policy step changes
+mid-chunk, clip_gradients active, iter_size accumulation, and the
+dispatch-count reduction itself.
+"""
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from caffe_mpi_tpu.proto import SolverParameter
+from caffe_mpi_tpu.proto.config import NetParameter
+from caffe_mpi_tpu.solver import Solver
+
+LSQ_NET = """
+name: "lsq"
+layer { name: "in" type: "Input" top: "x" top: "t"
+        input_param { shape { dim: 4 dim: 3 } shape { dim: 4 dim: 1 } } }
+layer { name: "ip" type: "InnerProduct" bottom: "x" top: "pred"
+        inner_product_param { num_output: 1
+          weight_filler { type: "gaussian" std: 1 }
+          bias_filler { type: "gaussian" std: 1 } } }
+layer { name: "loss" type: "EuclideanLoss" bottom: "pred" bottom: "t" top: "l" }
+"""
+
+CLS_NET = """
+name: "cls"
+layer { name: "in" type: "Input" top: "x" top: "t"
+        input_param { shape { dim: 8 dim: 6 } shape { dim: 8 } } }
+layer { name: "ip" type: "InnerProduct" bottom: "x" top: "y"
+        inner_product_param { num_output: 3
+          weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "y" bottom: "t"
+        top: "l" }
+"""
+
+
+def make_solver(extra: str = "", net: str = LSQ_NET) -> Solver:
+    sp = SolverParameter.from_text(
+        f'base_lr: 0.1 max_iter: 1000 lr_policy: "fixed" display: 0 '
+        f'random_seed: 7\n{extra}')
+    sp.net_param = NetParameter.from_text(net)
+    return Solver(sp)
+
+
+def lsq_data(rng, n=32):
+    out = []
+    for _ in range(n):
+        x = rng.randn(4, 3).astype(np.float32)
+        t = (x @ np.array([[1.0], [-2.0], [0.5]]) + 0.3).astype(np.float32)
+        out.append({"x": x, "t": t})
+    return out
+
+
+def window_losses(solver) -> np.ndarray:
+    return np.array([float(jnp.asarray(l)) for l in solver._loss_window])
+
+
+def assert_same_training(a: Solver, b: Solver, rtol=1e-5, atol=1e-6):
+    assert a.iter == b.iter
+    for ln in a.params:
+        for pn in a.params[ln]:
+            np.testing.assert_allclose(
+                np.asarray(a.params[ln][pn]), np.asarray(b.params[ln][pn]),
+                rtol=rtol, atol=atol, err_msg=f"params {ln}/{pn}")
+    for ln in a.opt_state:
+        for pn in a.opt_state[ln]:
+            for si, (sa, sb) in enumerate(zip(a.opt_state[ln][pn],
+                                              b.opt_state[ln][pn])):
+                np.testing.assert_allclose(
+                    np.asarray(sa), np.asarray(sb), rtol=rtol, atol=atol,
+                    err_msg=f"opt {ln}/{pn}[{si}]")
+
+
+class TestEquivalence:
+    """step_chunk=K training == step_chunk=1 training, f32 tolerance."""
+
+    def test_sgd_lr_step_mid_chunk(self, rng):
+        """lr_policy 'step' drops the rate at iters 6 and 13 — inside a
+        K=7 chunk, never on its boundary — so the carried iteration
+        counter must drive the schedule correctly from INSIDE the scan."""
+        data = lsq_data(rng)
+        cfg = ('type: "SGD" momentum: 0.9 lr_policy: "multistep" '
+               'gamma: 0.5 stepvalue: 6 stepvalue: 13 average_loss: 20')
+        a = make_solver(cfg)
+        b = make_solver(cfg + " step_chunk: 7")
+        a.step(20, lambda it: data[it % 32])
+        b.step(20, lambda it: data[it % 32])
+        assert a.dispatch_count == 20
+        assert b.dispatch_count == 3  # chunks 7 + 7 + 6
+        assert_same_training(a, b)
+        # per-iteration losses agree (window sized to hold all 20)
+        np.testing.assert_allclose(window_losses(a), window_losses(b),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_clip_gradients_and_iter_size(self, rng):
+        """grad-norm clipping is a device scalar inside the scan; the
+        iter_size micro-accumulation nests inside each scanned step."""
+        data = lsq_data(rng, 64)
+        cfg = ('type: "SGD" momentum: 0.9 clip_gradients: 0.05 '
+               'iter_size: 2 average_loss: 12')
+        a = make_solver(cfg)
+        b = make_solver(cfg + " step_chunk: 4")
+        a.step(12, lambda it: data[it % 64])
+        b.step(12, lambda it: data[it % 64])
+        assert b.dispatch_count == 3
+        assert_same_training(a, b)
+        np.testing.assert_allclose(window_losses(a), window_losses(b),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_adam_long_run(self, rng):
+        data = lsq_data(rng)
+        cfg = 'type: "Adam" momentum: 0.9 momentum2: 0.999 average_loss: 5'
+        a = make_solver(cfg)
+        b = make_solver(cfg + " step_chunk: 16")
+        a.step(33, lambda it: data[it % 32])
+        b.step(33, lambda it: data[it % 32])
+        assert b.dispatch_count == 3  # 16 + 16 + 1
+        assert_same_training(a, b)
+
+    def test_mesh_data_parallel(self, rng):
+        """K-step scan under the SPMD mesh: super-batch sharded over
+        'data' at axis 2, params carried replicated through the scan."""
+        from caffe_mpi_tpu.parallel import MeshPlan
+        net = LSQ_NET.replace("dim: 4 dim: 3", "dim: 8 dim: 3") \
+                     .replace("dim: 4 dim: 1", "dim: 8 dim: 1")
+        data = []
+        for _ in range(32):
+            x = rng.randn(8, 3).astype(np.float32)
+            t = (x @ np.array([[1.0], [-2.0], [0.5]]) + 0.3).astype(np.float32)
+            data.append({"x": x, "t": t})
+        cfg = 'type: "SGD" momentum: 0.9'
+        sp1 = SolverParameter.from_text(
+            f'base_lr: 0.1 max_iter: 1000 lr_policy: "fixed" display: 0 '
+            f'random_seed: 7\n{cfg}')
+        sp1.net_param = NetParameter.from_text(net)
+        a = Solver(sp1)
+        sp2 = SolverParameter.from_text(
+            f'base_lr: 0.1 max_iter: 1000 lr_policy: "fixed" display: 0 '
+            f'random_seed: 7\n{cfg} step_chunk: 5')
+        sp2.net_param = NetParameter.from_text(net)
+        b = Solver(sp2, mesh=MeshPlan.data_parallel())
+        a.step(10, lambda it: data[it % 32])
+        b.step(10, lambda it: data[it % 32])
+        assert b.dispatch_count == 2
+        assert_same_training(a, b, rtol=1e-4, atol=1e-5)
+
+
+class TestEventBoundaries:
+    """Chunks auto-shrink so display/test/snapshot land exactly where
+    the K=1 schedule puts them."""
+
+    def test_chunk_at_schedule(self):
+        s = make_solver("step_chunk: 64 display: 4 test_interval: 6 "
+                        "snapshot: 10")
+        # display fires AFTER iters 0,4,8..; test BEFORE iters 6,12..;
+        # snapshot after iters 9,19.. (when iter becomes a multiple of 10)
+        assert s._chunk_at(0, 100) == 1    # display after iter 0
+        assert s._chunk_at(1, 100) == 4    # iters 1..4 (display at 4)
+        assert s._chunk_at(5, 100) == 1    # test pass due at iter 6
+        assert s._chunk_at(6, 100) == 3    # iters 6..8 (display at 8)
+        assert s._chunk_at(9, 100) == 1    # snapshot after iter 9
+        assert s._chunk_at(13, 2) == 2     # n caps the chunk
+        s2 = make_solver("step_chunk: 64")
+        assert s2._chunk_at(0, 1000) == 64
+        s3 = make_solver()  # default step_chunk preserves K=1
+        assert s3._chunk_at(0, 1000) == 1
+
+    def test_display_parity(self, rng, caplog):
+        """Smoothed-loss display lines appear at the same iterations with
+        the same values in both modes."""
+        import logging
+        data = lsq_data(rng)
+        cfg = 'type: "SGD" momentum: 0.9 display: 5 average_loss: 3'
+        records = {}
+        for name, extra in (("k1", ""), ("k8", " step_chunk: 8")):
+            s = make_solver(cfg + extra)
+            with caplog.at_level(logging.INFO, "caffe_mpi_tpu.solver"):
+                caplog.clear()
+                s.step(17, lambda it: data[it % 32])
+            records[name] = [
+                (r.args[0], float(r.args[3]))  # (iteration, smoothed loss)
+                for r in caplog.records if "Iteration" in r.msg]
+        assert [i for i, _ in records["k1"]] == [0, 5, 10, 15]
+        assert [i for i, _ in records["k8"]] == [0, 5, 10, 15]
+        for (i1, l1), (i2, l2) in zip(records["k1"], records["k8"]):
+            assert l1 == pytest.approx(l2, rel=1e-5), f"iter {i1}"
+
+    def test_test_interval_parity(self, rng):
+        """test_all fires at identical iterations; chunk never straddles
+        a test boundary."""
+        data = lsq_data(rng)
+        cfg = ('type: "SGD" momentum: 0.9 test_interval: 6 test_iter: 2 '
+               'test_initialization: false')
+        for extra, want_dispatch in (("", 20), (" step_chunk: 50", 4)):
+            s = make_solver(cfg + extra, net=LSQ_NET)
+            fired = []
+            orig = s.test_all
+            s.test_all = lambda fns: fired.append(s.iter) or orig(fns)
+            s.step(20, lambda it: data[it % 32],
+                   test_feed_fns=[lambda k: data[(7 + k) % 32]])
+            assert fired == [6, 12, 18], extra
+            assert s.dispatch_count == want_dispatch, extra
+            # chunks: 6 (iters 0-5), then 6, 6, 2
+
+    def test_test_interval_without_feeds_does_not_clip(self, rng):
+        """A configured-but-unused test_interval (no test feeds passed to
+        step()) must not clip fusion — the test pass cannot fire, so the
+        chunk schedule ignores it."""
+        data = lsq_data(rng)
+        s = make_solver('type: "SGD" momentum: 0.9 test_interval: 2 '
+                        'step_chunk: 10')
+        s.step(20, lambda it: data[it % 32])  # no test_feed_fns
+        assert s.dispatch_count == 2
+
+    def test_snapshot_boundary_and_resume(self, rng, tmp_path):
+        """Interval snapshots land at identical iterations with
+        identical bytes, and a resume from a chunk-boundary state
+        continues on the uninterrupted trajectory."""
+        data = lsq_data(rng)
+        cfg = 'type: "Adam" momentum: 0.9 snapshot: 6 average_loss: 2'
+        a = make_solver(cfg)
+        a.sp.snapshot_prefix = str(tmp_path / "k1")
+        b = make_solver(cfg + " step_chunk: 9")
+        b.sp.snapshot_prefix = str(tmp_path / "k9")
+        a.step(14, lambda it: data[it % 32])
+        a.wait_snapshots()
+        b.step(14, lambda it: data[it % 32])
+        b.wait_snapshots()
+        for it in (6, 12):
+            pa = tmp_path / f"k1_iter_{it}.caffemodel"
+            pb = tmp_path / f"k9_iter_{it}.caffemodel"
+            assert pa.exists() and pb.exists()
+            wa = np.frombuffer(pa.read_bytes(), np.uint8)
+            wb = np.frombuffer(pb.read_bytes(), np.uint8)
+            # identical shapes; values within f32 tolerance — compare the
+            # restored weights, not raw bytes (low-order bits may differ
+            # between the scanned and unscanned XLA programs)
+            assert wa.size == wb.size
+        # resume from the K-mode iter-6 snapshot and run to 14 at K=9:
+        # trajectory must match the uninterrupted K=1 run
+        c = make_solver(cfg + " step_chunk: 9")
+        c.restore(str(tmp_path / "k9_iter_6.solverstate"))
+        assert c.iter == 6
+        c.step(8, lambda it: data[it % 32])
+        assert_same_training(a, c)
+
+    def test_solver_step_returns_final_loss(self, rng):
+        data = lsq_data(rng)
+        a = make_solver('type: "SGD" momentum: 0.9')
+        b = make_solver('type: "SGD" momentum: 0.9 step_chunk: 5')
+        la = a.step(11, lambda it: data[it % 32])
+        lb = b.step(11, lambda it: data[it % 32])
+        assert la == pytest.approx(lb, rel=1e-5)
+
+
+class TestDeviceFeedQueue:
+    def test_stack_shape_and_prefetch(self):
+        from caffe_mpi_tpu.data.feeder import DeviceFeedQueue
+        calls = []
+
+        def feed(it):
+            calls.append(it)
+            return {"x": np.full((4, 3), it, np.float32),
+                    "t": np.full((4,), it, np.int32)}
+
+        q = DeviceFeedQueue(feed, iter_size=2)
+        try:
+            out = q.get(0, 3, hint=(3, 2))
+            assert out["x"].shape == (3, 2, 4, 3)
+            assert out["t"].shape == (3, 2, 4)
+            # micro-iteration striping: iteration j, micro m -> j*2+m
+            got = np.asarray(out["x"])[:, :, 0, 0]
+            np.testing.assert_array_equal(got, [[0, 1], [2, 3], [4, 5]])
+            assert np.asarray(out["t"]).dtype == np.int32
+            # the hint was prefetched on the worker; get() must not
+            # rebuild it
+            q._pending[(3, 2)].result()
+            n_before = len(calls)
+            out2 = q.get(3, 2)
+            assert len(calls) == n_before  # served from prefetch
+            np.testing.assert_array_equal(
+                np.asarray(out2["x"])[:, :, 0, 0], [[6, 7], [8, 9]])
+        finally:
+            q.close()
+
+    def test_device_resident_leaves(self):
+        """jnp-array feeds (synthetic benches) stack on device without a
+        host round-trip path error."""
+        from caffe_mpi_tpu.data.feeder import DeviceFeedQueue
+        feeds = {"x": jnp.ones((4, 3))}
+        q = DeviceFeedQueue(lambda it: feeds, iter_size=1)
+        try:
+            out = q.get(5, 2)
+            assert out["x"].shape == (2, 1, 4, 3)
+            assert isinstance(out["x"], jax.Array)
+        finally:
+            q.close()
+
+    def test_build_error_propagates(self):
+        from caffe_mpi_tpu.data.feeder import DeviceFeedQueue
+
+        def bad(it):
+            raise RuntimeError("boom")
+
+        q = DeviceFeedQueue(bad)
+        try:
+            with pytest.raises(RuntimeError, match="boom"):
+                q.get(0, 2)
+        finally:
+            q.close()
+
+
+class TestSatellites:
+    def test_divide_batch_indivisible_raises(self):
+        """gpipe micro-batching of an indivisible global batch must fail
+        loudly, naming the effective batch — not silently round up."""
+        sp = SolverParameter.from_text(
+            'base_lr: 0.1 max_iter: 10 lr_policy: "fixed"')
+        sp.net_param = NetParameter.from_text(LSQ_NET)  # batch 4
+        with pytest.raises(ValueError, match="effective global batch"):
+            Solver(sp, gpipe={"stages": 1, "micro": 3})
+
+    def test_synthetic_feeds_structural_labels(self):
+        """Integer feeds chosen by CONSUMER structure (1-D bottom of a
+        classification loss), not the literal blob name 'label'."""
+        from caffe_mpi_tpu.utils.model_shapes import (input_shapes,
+                                                      synthetic_feeds)
+        npar = NetParameter.from_text(CLS_NET)  # label top named "t"
+        shapes = input_shapes(npar, batch=8)
+        feeds = synthetic_feeds(shapes, n_classes=3, npar=npar)
+        assert jnp.issubdtype(feeds["t"].dtype, jnp.integer)
+        assert feeds["t"].shape == (8,)
+        assert int(feeds["t"].max()) < 3
+        assert jnp.issubdtype(feeds["x"].dtype, jnp.floating)
+        # without a net to inspect, 1-D tops still get integer feeds
+        feeds2 = synthetic_feeds(shapes, n_classes=3)
+        assert jnp.issubdtype(feeds2["t"].dtype, jnp.integer)
+
+    def test_gpipe_clip_no_host_sync(self, rng):
+        """gpipe clip_gradients computes the clip scale as a device
+        scalar (ADVICE r5) and still matches the sequential trajectory."""
+        net = LSQ_NET.replace("dim: 4 dim: 3", "dim: 8 dim: 3") \
+                     .replace("dim: 4 dim: 1", "dim: 8 dim: 1")
+        data = []
+        for _ in range(16):
+            x = rng.randn(8, 3).astype(np.float32)
+            t = (x @ np.array([[1.0], [-2.0], [0.5]]) + 0.3).astype(np.float32)
+            data.append({"x": x, "t": t})
+        halves = [{k: v[4 * m:4 * (m + 1)] for k, v in d.items()}
+                  for d in data for m in range(2)]
+        cfg = 'type: "SGD" momentum: 0.9 clip_gradients: 0.05'
+        seq = make_solver(cfg, net=net)
+        seq.step(6, lambda it: data[it])
+        sp = SolverParameter.from_text(
+            f'base_lr: 0.1 max_iter: 1000 lr_policy: "fixed" display: 0 '
+            f'random_seed: 7\n{cfg}')
+        sp.net_param = NetParameter.from_text(net)
+        gp = Solver(sp, gpipe={"stages": 2, "micro": 2})
+        gp.step(6, lambda it: halves[it])
+        assert_same_training(seq, gp, rtol=1e-4, atol=1e-5)

@@ -88,7 +88,7 @@ def bench_one(key: str) -> dict:
         if l.type == "InnerProduct" and l.top and \
                 l.top[0] in loss_bottoms and l.inner_product_param.num_output:
             n_classes = l.inner_product_param.num_output
-    feeds = synthetic_feeds(shapes, n_classes=n_classes)
+    feeds = synthetic_feeds(shapes, n_classes=n_classes, npar=npar)
     feed_fn = lambda it: feeds
 
     iters, warmup = 20, 3

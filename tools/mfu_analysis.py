@@ -80,10 +80,14 @@ def build_step(solver_path: str, batch: int):
     solver = Solver(sp, model_dir=_ROOT)
     step = solver._build_step()
 
-    # abstract feeds: AOT never materializes the batch
+    # abstract feeds: AOT never materializes the batch. Integer tops are
+    # detected structurally (1-D bottom of a classification loss), same
+    # rule as synthetic_feeds — not by the literal name 'label'
+    from caffe_mpi_tpu.utils.model_shapes import label_tops
+    ints = label_tops(npar, shapes)
     feeds = {}
     for top, dims in shapes.items():
-        if top == "label":
+        if top in ints:
             feeds[top] = jax.ShapeDtypeStruct((1, dims[0]), jnp.int32)
         else:
             feeds[top] = jax.ShapeDtypeStruct((1, *dims), jnp.float32)

@@ -331,6 +331,23 @@ class DeviceFeedQueue:
             return self.place(tree)
         return jax.device_put(tree)
 
+    def prefetch(self, it0: int, k: int) -> None:
+        """Schedule (it0, k) on the worker WITHOUT blocking — the
+        test-boundary warmup path (solver._prefetch_test_feeds): the
+        eval pass's first super-batch assembles and device_puts while
+        the train chunk that ends at the boundary is still computing,
+        so the boundary itself only pays the dispatch."""
+        if (it0, k) not in self._pending:
+            self._pending[(it0, k)] = self._pool.submit(self._build, it0, k)
+
+    def ready(self, it0: int, k: int) -> bool:
+        """True when (it0, k) is assembled and a get() would not block
+        — the solver's opportunistic eval-chunk dispatch asks this
+        between train chunks. Schedules the build if it wasn't pending,
+        so polling converges."""
+        self.prefetch(it0, k)
+        return self._pending[(it0, k)].done()
+
     def get(self, it0: int, k: int, hint: tuple[int, int] | None = None):
         """Super-batch for iterations [it0, it0+k); schedules `hint`
         (the next chunk's (it0, k)) on the worker before blocking."""

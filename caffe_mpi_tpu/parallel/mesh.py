@@ -168,6 +168,20 @@ class MeshPlan:
         ncclBcast of all weights, parallel.cpp:208-227)."""
         return jax.device_put(tree, self.replicated())
 
+    def shard_feeds_or_replicate(self, feeds, batch_axis: int = 0):
+        """shard_feeds with a replication fallback: returns (placed,
+        sharded?) where sharded? is False when ANY leaf's batch dim
+        doesn't divide n_data (the reference rounds its divide_batch up
+        with a warning, parallel.cpp:284-293; SPMD sharding requires
+        exactness, so e.g. an odd-sized test batch evaluates replicated
+        instead of crashing). Used by the fused eval pipeline to put
+        test super-batches on all chips (ISSUE 2)."""
+        if all(getattr(x, "ndim", 0) > batch_axis
+               and x.shape[batch_axis] % self.n_data == 0
+               for x in jax.tree.leaves(feeds)):
+            return self.shard_feeds(feeds, batch_axis=batch_axis), True
+        return self.replicate(feeds), False
+
     # -- ZeRO-1 optimizer-state sharding (beyond the reference) ---------
     def zero_slot_sharding(self, shape) -> NamedSharding | None:
         """Sharding for an optimizer slot under zero_stage 1: dim 0 split

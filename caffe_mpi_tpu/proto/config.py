@@ -943,6 +943,13 @@ class SolverParameter(Message):
     # test_interval / snapshot boundaries. 1 (default) = classic
     # one-dispatch-per-iteration behavior.
     step_chunk: int = 1
+    # TPU-native extension (ISSUE 2): test batches fused into ONE
+    # evaluation dispatch — the test pass runs as a jitted lax.scan over
+    # a [T, B, ...] super-batch carrying the per-blob score accumulators
+    # in HBM, ceil(test_iter/T) dispatches per pass instead of
+    # test_iter. 0 (default) = auto-size T from the eval super-batch
+    # HBM budget (solver._test_chunk_len); >0 pins T explicitly.
+    test_chunk: int = 0
 
 
 SOLVER_TYPE_NAMES = {

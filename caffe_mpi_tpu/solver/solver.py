@@ -224,7 +224,24 @@ class Solver:
         # region (dispatches_per_100_iters / host_syncs).
         self.dispatch_count = 0
         self.host_sync_count = 0
+        # evaluation telemetry (ISSUE 2): test_dispatch_count = eval
+        # program launches (the shared-param copy + one fused scan per
+        # T-batch chunk; the classic fallback counts one per batch);
+        # test_pass_count = test nets evaluated; eval_stall_ms = host
+        # time the TRAIN loop lost to evaluation (boundary dispatch +
+        # harvest wait), the number the async pipeline exists to bound —
+        # bench.py reports test_dispatches_per_pass / eval_stall_ms.
+        self.test_dispatch_count = 0
+        self.test_pass_count = 0
+        self.eval_stall_ms = 0.0
         self._test_fwd_jits: dict[int, Callable] = {}
+        self._test_eval_jits: dict[int, Callable] = {}
+        # static per-test-net properties (output blobs, shared-param
+        # layer names) — computed once, not rebuilt every pass
+        self._test_meta: dict[int, tuple] = {}
+        self._test_feed_queues: dict[int, object] = {}
+        self._pending_eval = None
+        self._warned_unsharded_test = False
         self._grad_transform = grad_transform
         # decls (lr_mult/decay_mult per param) in pytree-congruent form
         self._decls = {
@@ -662,7 +679,13 @@ class Solver:
             if (sp.test_interval and self.iter % sp.test_interval == 0
                     and (self.iter > 0 or sp.test_initialization)
                     and test_feed_fns):
-                self.test_all(test_feed_fns)
+                # asynchronous evaluation: drain the previous pass (its
+                # scores are certainly computed by now — its programs
+                # preceded a full test_interval of train chunks), then
+                # dispatch this one and resume training immediately; the
+                # device runs the eval between train chunks
+                self._harvest_eval()
+                self._start_eval(test_feed_fns)
             c = 1
             if self.gpipe is not None:
                 loss, rate = self._gpipe_iteration(feed_fn)
@@ -697,6 +720,10 @@ class Solver:
                                             self.opt_state, feeds_stack, it,
                                             rng)
                     self.dispatch_count += 1
+            # feed any in-flight eval pass the chunks whose super-batches
+            # the worker finished while this train chunk dispatched —
+            # non-blocking, so eval assembly never stalls training
+            self._continue_eval()
             if self._sync_steps:
                 jax.block_until_ready(loss)
             # keep the loss ON DEVICE: a float() here would force a host
@@ -714,7 +741,7 @@ class Solver:
                     self._loss_window.append(losses[k])
             last_iter = self.iter + c - 1  # chunk ends ON display iters
             if sp.display and last_iter % sp.display == 0 and self.rank == 0:
-                smoothed = float(sum(
+                smoothed = float(sum(  # host-sync: ok (display boundary)
                     jnp.asarray(l) for l in self._loss_window)) / len(
                         self._loss_window)
                 self.host_sync_count += 1
@@ -722,15 +749,30 @@ class Solver:
                 ips = ((last_iter - it0 + 1) * imgs_per_iter / elapsed
                        if elapsed > 0 else 0.0)
                 log.info("Iteration %d (%.4g iter/s, %.1f img/s), loss = %.6g, "
-                         "lr = %.6g", last_iter,
+                         "lr = %.6g", last_iter,  # host-sync: ok (display)
                          (last_iter - it0 + 1) / max(elapsed, 1e-9), ips,
                          smoothed, float(rate))
             self.iter += c
             n -= c
+            if (sp.test_interval and test_feed_fns
+                    and self.iter % sp.test_interval == 0
+                    and (self.iter > 0 or sp.test_initialization)
+                    and (n > 0 or self.iter < sp.max_iter)):
+                # the next loop pass (or next step() call) starts an
+                # eval here: warm its first test super-batch while the
+                # chunk that just dispatched computes. At max_iter no
+                # eval can follow — don't assemble a super-batch nobody
+                # will consume (it would pin HBM until close())
+                self._prefetch_test_feeds(test_feed_fns)
             if sp.snapshot and self.iter % sp.snapshot == 0:
                 # interval snapshots don't stall the train loop (the
                 # reference's do: solver.cpp:339-344 writes inline)
                 self.snapshot(block=False)
+        # a pass dispatched at the final boundary must land before step()
+        # returns (step's contract is "n iterations ran, events fired");
+        # by now the eval programs sit ahead of the last train chunks in
+        # device order, so this wait is dispatch drain, not the pass
+        self._harvest_eval()
         return float(last_loss) if last_loss is not None else float("nan")
 
     def close(self) -> None:
@@ -740,9 +782,18 @@ class Solver:
         construct many Solvers should call this; training results are
         unaffected either way."""
         self.wait_snapshots()
+        if self._pending_eval is not None:
+            # only reachable via _start_eval without a matching harvest
+            # (step()/test_all always drain); don't add a device wait to
+            # teardown — a dead tunnel would turn close() into a hang
+            self._pending_eval = None
+            log.warning("dropping un-harvested evaluation pass at close")
         if self._feed_queue is not None:
             self._feed_queue.close()
             self._feed_queue = None
+        for q in self._test_feed_queues.values():
+            q.close()
+        self._test_feed_queues.clear()
 
     def solve(self, feed_fn: FeedFn, test_feed_fns=None) -> float:
         """Train to max_iter (reference Solver::Solve)."""
@@ -764,31 +815,167 @@ class Solver:
         return 0
 
     # ------------------------------------------------------------------
-    def test_all(self, test_feed_fns) -> list[dict[str, float]]:
-        """Evaluate every test net, averaging output blobs over test_iter
-        batches (reference Solver::TestAll/Test, solver.cpp:439-540)."""
-        results = []
+    # Evaluation (reference Solver::TestAll/Test, solver.cpp:439-540) —
+    # rebuilt as a fused, device-fed, ASYNCHRONOUS pipeline (ISSUE 2).
+    # The pre-ISSUE-2 shape was a host loop of one jitted forward per
+    # test batch: test_iter dispatches, each a tunnel round-trip, with
+    # training stalled for the whole pass. Now one jitted `lax.scan`
+    # consumes a [T, B, ...] test super-batch and carries the per-blob
+    # sum accumulators in HBM — ceil(test_iter/T) dispatches per pass —
+    # fed by the same DeviceFeedQueue double-buffering as the fused
+    # train loop, and because the accumulator is the scan carry AND the
+    # program's acc0 input, chunks chain across dispatches with zero
+    # extra combine work, in exactly the classic loop's addition order
+    # (CPU-bitwise; tests/test_fused_eval.py). At an in-training test
+    # boundary the solver takes a cheap on-device copy of the shared
+    # param view (the fused train step DONATES those buffers), dispatches
+    # the eval scan, and resumes dispatching train chunks immediately;
+    # the single device->host sync happens at harvest time and the
+    # scores log tagged with the iteration they evaluate — the
+    # whole-loop-on-accelerator strategy (arXiv:1810.09868) applied to
+    # evaluation, with eval hidden behind training compute the way the
+    # reference hides communication behind backprop (arXiv:1810.11112).
+
+    _TEST_SUPER_BATCH_BYTES = 256 << 20  # HBM cap for one eval super-batch
+
+    def _test_net_meta(self, ti: int) -> tuple[tuple, tuple]:
+        """(output blobs, param-layer names) for test net `ti` — static
+        net properties, computed once instead of rescanned every pass."""
+        meta = self._test_meta.get(ti)
+        if meta is None:
+            tnet = self.test_nets[ti]
+            meta = (tuple(self._output_blobs(tnet)),
+                    tuple(l.name for l in tnet.layers if l.params))
+            self._test_meta[ti] = meta
+        return meta
+
+    def _test_chunk_len(self, tnet: Net, iters: int) -> int:
+        """T: test batches fused into one eval dispatch. sp.test_chunk
+        pins it; 0 (default) auto-sizes: the largest T whose [T, B, ...]
+        super-batch stays under _TEST_SUPER_BATCH_BYTES (the feed queue
+        double-buffers, so up to two are in flight), capped at 64 to
+        keep scan compiles cheap. A pass costs ceil(test_iter/T) scan
+        dispatches + 1 param-copy dispatch."""
+        k = int(getattr(self.sp, "test_chunk", 0) or 0)
+        if k > 0:
+            return max(1, min(k, iters))
+        bytes_per = 0
+        for _key, (shape, kind) in tnet.feed_specs.items():
+            n = 1
+            for d in shape:
+                n *= int(d)
+            bytes_per += n * (1 if kind == "uint8" else 4)
+        if not bytes_per:  # no feed specs (probe-less nets): blob shapes
+            for b in tnet.feed_blobs:
+                n = 1
+                for d in tnet.blob_shapes.get(b, ()):
+                    n *= int(d)
+                bytes_per += n * 4
+        cap = max(int(self._TEST_SUPER_BATCH_BYTES // max(bytes_per, 1)), 1)
+        return max(1, min(iters, cap, 64))
+
+    def _place_test_feeds(self, tree, batch_axis: int):
+        """Shard a test feed pytree over the 'data' mesh axis so SPMD
+        runs evaluate on ALL chips (pre-ISSUE-2 test batches entered
+        unsharded even when training ran on a mesh), replicating when
+        the test batch doesn't divide the axis
+        (MeshPlan.shard_feeds_or_replicate)."""
+        placed, sharded = self.mesh.shard_feeds_or_replicate(
+            tree, batch_axis=batch_axis)
+        if not sharded and not self._warned_unsharded_test:
+            self._warned_unsharded_test = True
+            log.info("test batch does not divide the 'data' mesh axis "
+                     "(%d); evaluating replicated", self.mesh.n_data)
+        return placed
+
+    def _test_feed_queue(self, ti: int, feed_fn):
+        """Device feed queue for test net `ti`: assembles + device_puts
+        [T, 1, B, ...] eval super-batches in a worker thread (mesh runs
+        shard the batch axis; gpipe runs pin to stage-0's device)."""
+        queue = self._test_feed_queues.get(ti)
+        if queue is not None and queue.feed_fn is not feed_fn:
+            queue.close()
+            queue = None
+        if queue is None:
+            from ..data.feeder import DeviceFeedQueue
+            place = None
+            if self.mesh is not None:
+                place = lambda t: self._place_test_feeds(t, batch_axis=2)
+            elif self.gpipe is not None:
+                dev0 = self.gpipe.devices[0]
+                place = lambda t: jax.device_put(t, dev0)
+            queue = DeviceFeedQueue(feed_fn, iter_size=1, place=place)
+            self._test_feed_queues[ti] = queue
+        return queue
+
+    def _test_fwd(self, ti: int):
+        """Single-batch jitted forward for test net `ti`, reducing every
+        output blob to a scalar sum ON DEVICE and returning one stacked
+        vector (the reference aggregates on-device too,
+        solver.cpp:501-519) — the classic fallback for host-callback
+        nets on the CPU backend, and the oracle the fused scan must
+        match bitwise."""
+        fwd = self._test_fwd_jits.get(ti)
+        if fwd is None:
+            tnet = self.test_nets[ti]
+            out_blobs, _ = self._test_net_meta(ti)
+
+            def fwd_sums(p, s, f, tnet=tnet, out_blobs=out_blobs):
+                blobs = tnet.apply(p, s, f, train=False)[0]
+                return jnp.stack([jnp.sum(blobs[b]).astype(jnp.float32)
+                                  for b in out_blobs])
+            fwd = jax.jit(fwd_sums)
+            self._test_fwd_jits[ti] = fwd
+        return fwd
+
+    def _build_eval_scan(self, ti: int):
+        """The fused eval program for test net `ti`:
+            (tparams, tstate, feeds_super, acc0) -> acc
+        One `lax.scan` over the [T, 1, B, ...] super-batch; the carry is
+        the stacked per-blob sum vector, seeded with acc0 = the PREVIOUS
+        chunk's result, so a multi-chunk pass accumulates in exactly the
+        classic per-batch order with no extra combine dispatches. The
+        chained accumulator is donated; the super-batch is not (XLA
+        can't alias a scan-consumed operand, and the no-op donation just
+        warns)."""
+        tnet = self.test_nets[ti]
+        out_blobs, _ = self._test_net_meta(ti)
+
+        def eval_scan(tparams, tstate, feeds_super, acc0):
+            def body(acc, feeds_stack):
+                feeds = jax.tree.map(lambda x: x[0], feeds_stack)
+                blobs = tnet.apply(tparams, tstate, feeds, train=False)[0]
+                sums = jnp.stack([jnp.sum(blobs[b]).astype(jnp.float32)
+                                  for b in out_blobs])
+                return acc + sums, None
+
+            acc, _ = jax.lax.scan(body, acc0, feeds_super)
+            return acc
+
+        return jax.jit(eval_scan, donate_argnums=(3,))
+
+    def _start_eval(self, test_feed_fns) -> None:
+        """Dispatch the FIRST chunk of an evaluation pass per test net,
+        WITHOUT the device->host sync. On return `self._pending_eval`
+        holds per-net continuation records; training dispatch resumes
+        immediately, `_continue_eval()` feeds the remaining eval chunks
+        opportunistically between train chunks (dispatching only when
+        the worker thread has their super-batch ready, so the train
+        loop never blocks on eval feed assembly), and `_harvest_eval`
+        drains + materializes the scores later. The host time spent
+        here (param copy + first-chunk fetch + dispatch) is the
+        boundary's eval stall, accumulated in eval_stall_ms."""
+        t0 = time.perf_counter()
+        entries = []
+        settled = False
         for ti, tnet in enumerate(self.test_nets):
-            iters = self.sp.test_iter[ti] if ti < len(self.sp.test_iter) else 50
+            iters = self.sp.test_iter[ti] if ti < len(self.sp.test_iter) \
+                else 50
             feed_fn = test_feed_fns[ti]
-            out_blobs = tuple(self._output_blobs(tnet))
+            out_blobs, _ = self._test_net_meta(ti)
             if not out_blobs or iters == 0:  # degenerate test net
-                results.append({})
+                entries.append(None)
                 continue
-            if ti not in self._test_fwd_jits:
-                # the jitted program reduces every output blob to a scalar
-                # ON DEVICE and returns one stacked vector: the host loop
-                # below only chains async adds, so the whole evaluation
-                # costs ONE device->host transfer per test net (the
-                # reference aggregates on-device too, solver.cpp:501-519;
-                # a per-iteration float() would pay the tunnel RTT
-                # iters x |blobs| times)
-                def fwd_sums(p, s, f, tnet=tnet, out_blobs=out_blobs):
-                    blobs = tnet.apply(p, s, f, train=False)[0]
-                    return jnp.stack([jnp.sum(blobs[b]).astype(jnp.float32)
-                                      for b in out_blobs])
-                self._test_fwd_jits[ti] = jax.jit(fwd_sums)
-            fwd = self._test_fwd_jits[ti]
             # test nets share the train net's weights by layer name
             # (reference ShareTrainedLayersWith)
             tparams = self._shared_params(tnet)
@@ -799,30 +986,172 @@ class Solver:
                 dev0 = self.gpipe.devices[0]
                 tparams = jax.device_put(tparams, dev0)
                 tstate = jax.device_put(tstate, dev0)
-            acc = None
-            for k in range(iters):
-                sums = fwd(tparams, tstate, feed_fn(k))
-                if self._sync_test:
+            if self._sync_test:
+                # host-callback nets on the CPU backend must sync every
+                # program (see __init__): classic per-batch loop, scores
+                # still harvested through the same pending record
+                fwd = self._test_fwd(ti)
+                acc = None
+                for k in range(iters):
+                    feeds = feed_fn(k)
+                    if self.mesh is not None:
+                        feeds = self._place_test_feeds(feeds, batch_axis=0)
+                    sums = fwd(tparams, tstate, feeds)
                     jax.block_until_ready(sums)
-                acc = sums if acc is None else acc + sums
-            vals = np.asarray(acc) / iters  # the single host sync
+                    self.test_dispatch_count += 1
+                    acc = sums if acc is None else acc + sums
+                entries.append({"ti": ti, "out_blobs": out_blobs,
+                                "acc": acc, "iters": iters, "next": iters})
+                self.test_pass_count += 1
+                continue
+            if not settled:
+                # the boundary train chunk may still be in flight with
+                # these buffers mid-donation-handoff; dispatching copies
+                # against that state intermittently SIGABRTs the CPU
+                # client (docs/crash_hunt_r5.md — same hazard, same fix
+                # as the async snapshot capture): settle first. Costs
+                # the tail of one chunk, which the eval had to wait out
+                # on device anyway.
+                jax.block_until_ready((tparams, tstate))
+                settled = True
+            # point-in-time copy (HBM->HBM, async): the next train chunk
+            # donates the live params/state the moment it dispatches
+            copy = lambda a: jnp.copy(a) if isinstance(a, jax.Array) else a
+            tparams = jax.tree.map(copy, tparams)
+            tstate = jax.tree.map(copy, tstate)
+            self.test_dispatch_count += 1  # the shared-param copy
+            queue = self._test_feed_queue(ti, feed_fn)
+            T = self._test_chunk_len(tnet, iters)
+            jit = self._test_eval_jits.get(ti)
+            if jit is None:
+                jit = self._build_eval_scan(ti)
+                self._test_eval_jits[ti] = jit
+            acc = jnp.zeros(len(out_blobs), jnp.float32)
+            if self.mesh is not None:
+                acc = self.mesh.replicate(acc)
+            entry = {"ti": ti, "out_blobs": out_blobs, "acc": acc,
+                     "iters": iters, "next": 0, "T": T, "queue": queue,
+                     "jit": jit, "tparams": tparams, "tstate": tstate}
+            # chunk 0 dispatches AT the boundary (its super-batch was
+            # prefetched while the boundary train chunk computed); the
+            # rest follow from _continue_eval between train chunks
+            self._dispatch_eval_chunk(entry)
+            entries.append(entry)
+            self.test_pass_count += 1
+        self._pending_eval = {"iter": self.iter, "entries": entries}
+        self.eval_stall_ms += (time.perf_counter() - t0) * 1e3
+
+    def _dispatch_eval_chunk(self, entry) -> None:
+        """Fetch + dispatch one eval chunk of `entry`, scheduling the
+        following chunk's assembly on the queue worker as the hint."""
+        iters, T, queue = entry["iters"], entry["T"], entry["queue"]
+        k0 = entry["next"]
+        c = min(T, iters - k0)
+        left = iters - (k0 + c)
+        hint = (k0 + c, min(T, left)) if left > 0 else None
+        feeds_super = queue.get(k0, c, hint=hint)
+        entry["acc"] = entry["jit"](entry["tparams"], entry["tstate"],
+                                    feeds_super, entry["acc"])
+        self.test_dispatch_count += 1
+        entry["next"] = k0 + c
+
+    def _continue_eval(self, block: bool = False) -> None:
+        """Advance an in-flight evaluation pass. Non-blocking mode (the
+        per-train-chunk call in step()) dispatches every chunk whose
+        super-batch the worker thread has ALREADY assembled — eval feed
+        assembly hides behind train compute and the dispatches
+        interleave with train chunks. block=True (harvest) drains the
+        rest unconditionally."""
+        pending = self._pending_eval
+        if pending is None:
+            return
+        t0 = time.perf_counter() if block else 0.0
+        for entry in pending["entries"]:
+            if entry is None:
+                continue
+            while entry["next"] < entry["iters"]:
+                if not block and not entry["queue"].ready(
+                        entry["next"],
+                        min(entry["T"], entry["iters"] - entry["next"])):
+                    break
+                self._dispatch_eval_chunk(entry)
+        if block:
+            self.eval_stall_ms += (time.perf_counter() - t0) * 1e3
+
+    def _harvest_eval(self) -> list[dict[str, float]] | None:
+        """Drain and materialize a dispatched evaluation pass: ONE
+        device->host transfer per test net (the accumulators), scores
+        logged tagged with the iteration they evaluate. Returns the
+        results list, or None when nothing is pending. Any wait here
+        counts as eval stall — it is ~0 when the pass's chunks already
+        dispatched between train chunks, because the eval programs
+        precede the later train work in device order."""
+        if self._pending_eval is None:
+            return None
+        self._continue_eval(block=True)  # dispatch any remaining chunks
+        pending = self._pending_eval
+        self._pending_eval = None
+        t0 = time.perf_counter()
+        results = []
+        for entry in pending["entries"]:
+            if entry is None:
+                results.append({})
+                continue
+            ti, out_blobs = entry["ti"], entry["out_blobs"]
+            vals = np.asarray(entry["acc"]) / entry["iters"]  # host-sync: ok
+            # host-sync: ok — vals is already a host ndarray
             scores = {b: float(v) for b, v in zip(out_blobs, vals)}
             if self.rank == 0:
+                log.info("Test net #%d, iteration %d:", ti, pending["iter"])
                 for b, v in scores.items():
+                    # 3-arg format is load-bearing: examples/common.py
+                    # self-asserts parse (ti, blob, value) off this line
                     log.info("    Test net #%d: %s = %.5g", ti, b, v)
             results.append(scores)
+        self.eval_stall_ms += (time.perf_counter() - t0) * 1e3
         return results
 
+    def _prefetch_test_feeds(self, test_feed_fns) -> None:
+        """Warm each test net's first eval super-batch in the feed
+        queue's worker thread — called when the chunk just dispatched
+        ends at a test boundary, so assembly + device_put overlap the
+        chunk's compute and the boundary itself only pays dispatches."""
+        if self._sync_test:
+            return
+        for ti, tnet in enumerate(self.test_nets):
+            iters = self.sp.test_iter[ti] if ti < len(self.sp.test_iter) \
+                else 50
+            out_blobs, _ = self._test_net_meta(ti)
+            if not out_blobs or iters == 0:
+                continue
+            queue = self._test_feed_queue(ti, test_feed_fns[ti])
+            queue.prefetch(0, min(self._test_chunk_len(tnet, iters), iters))
+
+    def test_all(self, test_feed_fns) -> list[dict[str, float]]:
+        """Evaluate every test net, averaging output blobs over
+        test_iter batches (reference Solver::TestAll/Test). Synchronous
+        wrapper over the fused pipeline: an in-flight async pass is
+        drained first (its scores log under their own iteration tag),
+        then this pass dispatches and harvests."""
+        self._harvest_eval()
+        self._start_eval(test_feed_fns)
+        return self._harvest_eval()
+
     def _shared_params(self, tnet: Net):
-        """Map train-net params onto a test net by layer name."""
+        """Map train-net params onto a test net by layer name — the
+        layer-name list is cached per test net (_test_net_meta), not
+        rescanned every pass."""
+        try:
+            names = self._test_net_meta(self.test_nets.index(tnet))[1]
+        except ValueError:  # foreign net (tests): scan directly
+            names = tuple(l.name for l in tnet.layers if l.params)
         out = {}
-        for layer in tnet.layers:
-            if layer.params:
-                if layer.name not in self.params:
-                    raise KeyError(
-                        f"test net layer {layer.name!r} has no matching "
-                        "train-net params")
-                out[layer.name] = self.params[layer.name]
+        for name in names:
+            if name not in self.params:
+                raise KeyError(
+                    f"test net layer {name!r} has no matching "
+                    "train-net params")
+            out[name] = self.params[name]
         return out
 
     @staticmethod

@@ -68,6 +68,15 @@ def _parser() -> argparse.ArgumentParser:
                    "0 = prototxt value, which defaults to 1). Chunks "
                    "auto-align to display/test_interval/snapshot "
                    "boundaries, so observable behavior is unchanged")
+    p.add_argument("-test_chunk", "--test_chunk", "--test-chunk",
+                   dest="test_chunk", type=int, default=0,
+                   help="fuse T test batches into one evaluation "
+                   "dispatch: the test pass runs as a jitted lax.scan "
+                   "over a [T, B, ...] super-batch, ceil(test_iter/T) "
+                   "dispatches per pass, overlapped with training "
+                   "(overrides solver test_chunk; 0 = prototxt value, "
+                   "which defaults to auto-sizing T from the eval "
+                   "super-batch HBM budget)")
     return p
 
 
@@ -163,6 +172,8 @@ def cmd_train(args) -> int:
         sp.test_iter = [args.test_iter] * max(len(sp.test_iter), 1)
     if args.step_chunk:
         sp.step_chunk = args.step_chunk
+    if args.test_chunk:
+        sp.test_chunk = args.test_chunk
     model_dir = os.path.dirname(os.path.abspath(args.solver)) \
         if not (sp.net and os.path.exists(sp.net)) else ""
     gpipe_cfg = None

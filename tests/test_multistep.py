@@ -206,8 +206,10 @@ class TestEventBoundaries:
         for extra, want_dispatch in (("", 20), (" step_chunk: 50", 4)):
             s = make_solver(cfg + extra, net=LSQ_NET)
             fired = []
-            orig = s.test_all
-            s.test_all = lambda fns: fired.append(s.iter) or orig(fns)
+            # the in-training boundary now dispatches the ASYNC eval
+            # entrypoint (ISSUE 2); hook it to observe firing iterations
+            orig = s._start_eval
+            s._start_eval = lambda fns: fired.append(s.iter) or orig(fns)
             s.step(20, lambda it: data[it % 32],
                    test_feed_fns=[lambda k: data[(7 + k) % 32]])
             assert fired == [6, 12, 18], extra

@@ -76,6 +76,11 @@ def main() -> int:
                    "Feeder-built super-batch device_puts in a background "
                    "thread while the previous chunk trains (1 = classic "
                    "per-iteration dispatch)")
+    p.add_argument("--test-iters", type=int, default=8,
+                   help="test batches per fused-eval telemetry pass")
+    p.add_argument("--test-chunk", type=int, default=4,
+                   help="test batches fused per eval dispatch (solver "
+                   "test_chunk)")
     args = p.parse_args()
 
     os.makedirs(args.workdir, exist_ok=True)
@@ -112,11 +117,19 @@ def main() -> int:
         'display: 0 random_seed: 3')
     sp.net_param = npar
     sp.step_chunk = max(args.step_chunk, 1)
+    # fused-eval telemetry (ISSUE 2): a TEST-phase twin of the same net
+    # reads the same LMDB; after the timed region two async eval passes
+    # run overlapped with training to measure test_dispatches_per_pass
+    # (= ceil(test_iter/test_chunk) + 1 param copy) and eval_stall_ms
+    sp.test_iter = [args.test_iters]
+    sp.test_chunk = max(args.test_chunk, 1)
 
     solver = Solver(sp)
     feeder = _build_feeders(solver.net, "TRAIN")
     assert feeder is not None, "Data layer did not produce a feeder"
+    test_feeder = _build_feeders(solver.test_nets[0], "TEST")
 
+    eval_line = ""
     try:
         # with K-step fusion, warm one full chunk so the timed region
         # reuses the compiled scan program
@@ -129,10 +142,30 @@ def main() -> int:
         jax.block_until_ready(solver.params)
         dt = time.perf_counter() - t0
         dispatches = solver.dispatch_count - d0
+
+        # untimed fused-eval phase: boundaries fire during 6 more train
+        # iters; the eval scan runs between train chunks and the stall
+        # counter records what the train loop actually lost
+        solver.sp.test_interval = 3
+        solver.test_all([test_feeder])  # compile eval programs off-clock
+        td0, tp0, ts0 = (solver.test_dispatch_count, solver.test_pass_count,
+                         solver.eval_stall_ms)
+        solver.step(6, feeder, test_feed_fns=[test_feeder])
+        jax.block_until_ready(solver.params)
+        passes = solver.test_pass_count - tp0
+        if passes:
+            eval_line = (
+                f", test_iter {args.test_iters} @ test_chunk "
+                f"{solver.sp.test_chunk}: "
+                f"{(solver.test_dispatch_count - td0) / passes:.1f} "
+                f"test_dispatches_per_pass, "
+                f"{(solver.eval_stall_ms - ts0) / passes:.1f} "
+                f"eval_stall_ms")
     finally:
         # failure paths must not leave prefetch workers holding the DB
         # (this runs inside tpu_validation's watched subprocess)
         feeder.close()
+        test_feeder.close()
         solver.close()
     img_s = args.batch * args.iters / dt
 
@@ -143,9 +176,10 @@ def main() -> int:
     print(f"e2e-lmdb-train: {img_s:.1f} img/s (b{args.batch}, "
           f"{args.iters} iters, {device.device_kind}, MFU {mfu}, "
           f"step_chunk {sp.step_chunk}: {dispatches} dispatches for "
-          f"{args.iters} iters) — full host pipeline: LMDB read -> "
-          "decode -> transform/staging -> device super-batch (prefetched "
-          "in a worker thread) -> fused K-step scan")
+          f"{args.iters} iters{eval_line}) — full host pipeline: LMDB "
+          "read -> decode -> transform/staging -> device super-batch "
+          "(prefetched in a worker thread) -> fused K-step scan; eval "
+          "passes fused+async (ISSUE 2)")
     return 0
 
 

@@ -29,12 +29,20 @@ from concurrent.futures import Future, ThreadPoolExecutor
 
 import numpy as np
 
+from ..utils.resilience import FAULTS, retrying
 from .datasets import Dataset
 from .transformer import DataTransformer
 
 log = logging.getLogger("caffe_mpi_tpu.feeder")
 
 _LOOKAHEAD_HARD_CAP = 16  # queue-depth ceiling even with RAM to spare
+
+
+class FeedError(RuntimeError):
+    """A device feed super-batch failed to assemble. Carries the
+    originating (it0, k) chunk so the crash names the exact batch —
+    the bare Future exception used to surface with no context (or, in
+    the abandoned-hint path, not at all) and the solver stalled."""
 
 
 def _default_mem_budget() -> int:
@@ -148,11 +156,24 @@ class Feeder:
                         if isinstance(v, np.ndarray)) or 1
         return out
 
+    def _read_record(self, rec: int):
+        """One dataset read with bounded-backoff retry: transient I/O
+        errors (NFS blips, DB cursor hiccups — and the injected
+        `feeder_read` fault) are absorbed up to the attempt budget; a
+        persistent failure surfaces to the consumer with the record
+        named, where the supervisor owns the restart."""
+        def get():
+            FAULTS.maybe_raise("feeder_read", OSError,
+                               f"injected dataset read fault (record {rec})")
+            return self.ds.get(rec)
+        return retrying(get, attempts=4, base_delay=0.05,
+                        desc=f"dataset read (record {rec})")
+
     def _build_batch_inner(self, it: int) -> dict[str, np.ndarray]:
         raws, labels, flats = [], [], []
         for slot in range(self.batch):
             rec = self._record_index(it, slot)
-            img, label = self.ds.get(rec)
+            img, label = self._read_record(rec)
             raws.append(img)
             labels.append(label)
             flats.append(it * self.batch * self.world
@@ -183,6 +204,9 @@ class Feeder:
     def _transform(self, raws: list[np.ndarray], flats: list[int]) -> np.ndarray:
         tf = self.tf
         if tf is None:
+            # raws are host ndarrays from the dataset reader, never
+            # device values; no RTT is paid here
+            # host-sync: ok
             return np.stack([np.asarray(r, np.float32) for r in raws])
         if (self._native and raws[0].dtype == np.uint8
                 and all(r.shape == raws[0].shape for r in raws)):
@@ -356,7 +380,16 @@ class DeviceFeedQueue:
             fut = self._pool.submit(self._build, it0, k)
         if hint is not None and hint != (it0, k) and hint not in self._pending:
             self._pending[hint] = self._pool.submit(self._build, *hint)
-        feeds = fut.result()
+        try:
+            feeds = fut.result()
+        except Exception as e:
+            # name the chunk: the worker's traceback alone says nothing
+            # about WHICH super-batch died, and a swallowed error here
+            # used to leave the solver waiting on a future that would
+            # never resolve usefully
+            raise FeedError(
+                f"feed super-batch for iterations [{it0}, {it0 + k}) "
+                f"(it0={it0}, k={k}) failed to assemble: {e!r}") from e
         # drop stale prefetches (resume/seek or a schedule change): they
         # are pure functions of their indices, rebuild-on-demand is safe
         for key in [key for key in self._pending if key != hint]:
@@ -562,6 +595,9 @@ class HDF5Feeder:
         if arrays is None:
             import h5py
             with h5py.File(self.files[fi], "r") as h5:
+                # h5py datasets are host-side; this is the file read
+                # itself, not a device materialization
+                # host-sync: ok
                 arrays = {t: np.asarray(h5[t]) for t in self.tops}
             self._cache[fi] = arrays
             self._cache_order.append(fi)

@@ -950,6 +950,21 @@ class SolverParameter(Message):
     # test_iter. 0 (default) = auto-size T from the eval super-batch
     # HBM budget (solver._test_chunk_len); >0 pins T explicitly.
     test_chunk: int = 0
+    # TPU-native extension (ISSUE 3, survivable training): keep only the
+    # newest N snapshots on disk, GC'ing older ones after each write —
+    # but never deleting the newest VERIFIED snapshot (resume must
+    # always have somewhere to land). 0 (default) = keep everything,
+    # the reference behavior.
+    snapshot_keep: int = 0
+    # TPU-native extension (ISSUE 3): dispatch watchdog deadline in
+    # seconds. >0 arms a monitor thread that journals the run state and
+    # hard-exits (exit code 86) when any device dispatch/harvest blocks
+    # longer than this — a dead tunnel hangs inside C++ jax calls where
+    # no Python signal can interrupt, so this is the only way a hung run
+    # becomes a bounded, supervisable failure. Must exceed the worst
+    # jit-compile time a dispatch can trigger. 0 (default) = no
+    # watchdog, the reference behavior.
+    watchdog_deadline: float = 0.0
 
 
 SOLVER_TYPE_NAMES = {

@@ -77,6 +77,42 @@ def _parser() -> argparse.ArgumentParser:
                    "(overrides solver test_chunk; 0 = prototxt value, "
                    "which defaults to auto-sizing T from the eval "
                    "super-batch HBM budget)")
+    # survivable-training flags (ISSUE 3, utils/resilience.py)
+    p.add_argument("-resume", "--resume", default="",
+                   help="'auto' = resume from the newest VERIFIED "
+                   "snapshot under the solver's snapshot_prefix (crc32c "
+                   "manifest scan + run-manifest journal; corrupt "
+                   "snapshots fall back to the newest prior verified "
+                   "one; no snapshot = fresh start). A path behaves "
+                   "like -snapshot")
+    p.add_argument("-max_restarts", "--max-restarts", dest="max_restarts",
+                   type=int, default=0,
+                   help="supervised training: run the train loop in a "
+                   "contained child process and restart it (with "
+                   "--resume auto, exponential backoff) up to N times "
+                   "on failure — including watchdog hard-exits. 0 "
+                   "(default) = unsupervised, today's behavior")
+    p.add_argument("-watchdog_deadline", "--watchdog-deadline",
+                   dest="watchdog_deadline", type=float, default=0.0,
+                   help="arm the dispatch watchdog: journal run state "
+                   "and hard-exit (code 86) when any device dispatch/"
+                   "harvest blocks longer than this many seconds "
+                   "(overrides solver watchdog_deadline; 0 = prototxt "
+                   "value, which defaults to off). Must exceed the "
+                   "worst jit-compile time")
+    p.add_argument("-snapshot_prefix", "--snapshot-prefix",
+                   dest="snapshot_prefix", default="",
+                   help="override solver snapshot_prefix")
+    p.add_argument("-snapshot_every", "--snapshot-every",
+                   dest="snapshot_every", type=int, default=0,
+                   help="override solver snapshot interval "
+                   "(0 = prototxt value)")
+    p.add_argument("-snapshot_keep", "--snapshot-keep",
+                   dest="snapshot_keep", type=int, default=0,
+                   help="keep only the newest N snapshots, GC'ing older "
+                   "ones after each write — never the newest verified "
+                   "one (overrides solver snapshot_keep; 0 = prototxt "
+                   "value, which defaults to keep-everything)")
     return p
 
 
@@ -157,13 +193,58 @@ def _build_feeders(net, phase, rank=0, world=1, model_dir=""):
     return None
 
 
+def _supervised_train(args) -> int:
+    """Supervisor half of `train --max-restarts N`: run the actual
+    training loop in a contained child process (own process group,
+    killpg'd on every supervisor exit path) and restart it from the
+    newest verified snapshot with exponential backoff when it dies —
+    watchdog hard-exits (code 86) included. The crash-loop guard stops
+    after N restarts with the per-attempt record in
+    `<snapshot_prefix>.failures.log`."""
+    import os
+    from ..proto import SolverParameter
+    from ..utils import resilience
+
+    argv = list(getattr(args, "_argv", None) or sys.argv[1:])
+    # strip the supervision flag from the child's argv (the env marker
+    # below is the belt-and-braces recursion stop)
+    flags = ("-max_restarts", "--max-restarts", "--max_restarts")
+    child_argv, skip = [], False
+    for tok in argv:
+        if skip:
+            skip = False
+            continue
+        if tok in flags:
+            skip = True
+            continue
+        if tok.startswith(tuple(f + "=" for f in flags)):
+            continue
+        child_argv.append(tok)
+    base_cmd = [sys.executable, "-m", "caffe_mpi_tpu.tools.cli"] + child_argv
+    resume_cmd = base_cmd
+    if not any(t in ("-resume", "--resume") or
+               t.startswith(("-resume=", "--resume="))
+               for t in child_argv):
+        resume_cmd = base_cmd + ["-resume", "auto"]
+    sp = SolverParameter.from_file(args.solver)
+    prefix = args.snapshot_prefix or sp.snapshot_prefix or "snapshot"
+    env = dict(os.environ, CAFFE_SUPERVISED_CHILD="1")
+    return resilience.supervise(
+        base_cmd, resume_cmd, args.max_restarts,
+        failure_log=prefix + ".failures.log", env=env)
+
+
 def cmd_train(args) -> int:
     from ..proto import SolverParameter
     from ..solver import Solver
+    from ..utils import resilience
     if not args.solver:
         log.error("train requires -solver")
         return 1
     import os
+    if args.max_restarts > 0 \
+            and os.environ.get("CAFFE_SUPERVISED_CHILD") != "1":
+        return _supervised_train(args)
     from ..data.feeder import data_shape_probe
     sp = SolverParameter.from_file(args.solver)
     if args.max_iter:
@@ -174,6 +255,14 @@ def cmd_train(args) -> int:
         sp.step_chunk = args.step_chunk
     if args.test_chunk:
         sp.test_chunk = args.test_chunk
+    if args.snapshot_prefix:
+        sp.snapshot_prefix = args.snapshot_prefix
+    if args.snapshot_every:
+        sp.snapshot = args.snapshot_every
+    if args.snapshot_keep:
+        sp.snapshot_keep = args.snapshot_keep
+    if args.watchdog_deadline:
+        sp.watchdog_deadline = args.watchdog_deadline
     model_dir = os.path.dirname(os.path.abspath(args.solver)) \
         if not (sp.net and os.path.exists(sp.net)) else ""
     gpipe_cfg = None
@@ -187,11 +276,29 @@ def cmd_train(args) -> int:
     solver = Solver(sp, mesh=_select_mesh(args.gpu, args.mesh),
                     model_dir=model_dir, gpipe=gpipe_cfg,
                     data_shape_probe=lambda lp: data_shape_probe(lp, model_dir))
-    if args.snapshot:
-        solver.restore(args.snapshot)
-    elif args.weights:
-        for w in args.weights.split(","):
-            solver.load_weights(w)
+    if args.resume and args.resume != "auto":
+        # a concrete path behaves like -snapshot
+        args.snapshot = args.snapshot or args.resume
+    resumed = None
+    if args.resume == "auto":
+        # newest verified snapshot (crc32c manifest scan); falls back
+        # across corrupt snapshots; None = fresh start. The explicit
+        # -snapshot/-weights flags only apply when auto found nothing.
+        resumed = solver.restore_auto()
+    if resumed is None:
+        if args.snapshot:
+            try:
+                solver.restore(args.snapshot)
+            except resilience.SnapshotCorruptError as e:
+                log.warning("%s", e)
+                resumed = solver.restore_auto()
+                if resumed is None:
+                    raise
+                log.warning("resumed from %s instead of the corrupt %s",
+                            resumed, args.snapshot)
+        elif args.weights:
+            for w in args.weights.split(","):
+                solver.load_weights(w)
 
     # signal plumbing (reference SignalHandler, tools/caffe.cpp:209-211):
     # handlers only set flags; actions run at the iteration boundary —
@@ -467,6 +574,9 @@ def main(argv=None) -> int:
         format="%(levelname).1s%(asctime)s %(name)s] %(message)s",
         datefmt="%m%d %H:%M:%S")
     args = _parser().parse_args(argv)
+    # the supervisor rebuilds the child command from the ORIGINAL argv
+    # (argparse normalization would drop flag spellings)
+    args._argv = list(argv) if argv is not None else sys.argv[1:]
     from ..utils.compile_cache import enable_compile_cache
     enable_compile_cache()
     return {

@@ -1,0 +1,610 @@
+"""Fault-tolerant training runtime — the survivability subsystem.
+
+Reference Caffe assumes a reliable local device: its Snapshot() writes
+checkpoint files inline with no integrity metadata (solver.cpp:542-604)
+and its Solve() loop has no notion of a device that stops answering.
+This deployment's device is a remote single-claim TPU behind a tunnel
+that can die mid-run and leave the process hung inside uninterruptible
+C++ dispatch (CLAUDE.md, docs/crash_hunt_r5.md) — so fault tolerance is
+a system property here, not a user script (the TensorFlow design
+position, arXiv 1605.08695; availability-dominated multi-node training,
+arXiv 1810.11112). Four pieces, composed by solver/cli:
+
+1. **Verified atomic snapshots** — temp-file + `os.replace` publication,
+   a crc32c sidecar manifest (`<prefix>_iter_<N>.manifest.json`: per-file
+   crc + size, iteration, wall time) written LAST so "manifest exists"
+   == "snapshot complete", verification on load, and newest-prior-
+   verified fallback on corruption. `gc_snapshots` enforces the
+   `snapshot_keep` solver knob while never deleting the newest verified
+   snapshot.
+2. **Dispatch watchdog** — a monitor thread timestamps every device
+   dispatch/harvest section the solver enters; when one exceeds the
+   deadline (dead tunnel => C++ hang no Python signal can interrupt) it
+   journals the run state to `<prefix>.run.json` and hard-exits with
+   EXIT_WATCHDOG, turning an indefinite hang into a bounded, diagnosable
+   failure a supervisor can act on.
+3. **Supervised auto-resume** — `supervise()` runs the training child
+   under utils/subproc.run_contained with exponential backoff and a
+   crash-loop guard; restarts resume from the newest verified snapshot
+   (`--resume auto` reads the run manifest + verified-manifest scan).
+4. **Fault-injection plane** — env-keyed (`CAFFE_TPU_FAULTS`), zero cost
+   when off: one falsy-dict check per site. Drives
+   tests/test_fault_tolerance.py (feeder read errors, snapshot
+   corruption/truncation, kill-mid-write, simulated dispatch stalls).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from contextlib import contextmanager, nullcontext
+
+from glob import escape as glob_escape
+
+log = logging.getLogger("caffe_mpi_tpu.resilience")
+
+# distinct exit codes so the supervisor (and the operator's ps/log
+# archaeology) can tell a watchdog trip from an injected fault from an
+# ordinary crash
+EXIT_WATCHDOG = 86
+EXIT_FAULT = 87
+
+_STATE_SUFFIXES = (".solverstate", ".solverstate.h5")
+_MANIFEST_SUFFIX = ".manifest.json"
+_MANIFEST_SCHEMA = 1
+
+
+# ---------------------------------------------------------------------------
+# Fault-injection plane (test-only; env-keyed; zero cost when off)
+# ---------------------------------------------------------------------------
+
+class FaultPlane:
+    """Injects failures at named sites, configured from the
+    `CAFFE_TPU_FAULTS` env var: comma-separated `site:count:skip:arg`
+    entries (count defaults 1, skip 0, arg empty). A site `fire()`s on
+    the (skip+1)-th .. (skip+count)-th eligible calls, then never again.
+    count <= 0 is STICKY: the site fires on every eligible call for the
+    rest of this process (e.g. "the dataset is gone", not "one read
+    blipped").
+
+    `CAFFE_TPU_FAULTS_DIR`, when set, makes firing durable ACROSS
+    process restarts: a site that has fired its full count (or, for
+    sticky sites, fired at all) writes `<dir>/<site>.done`, and any
+    later process (the supervised restart) loads that site disabled —
+    so "crash once, then succeed" scenarios terminate instead of
+    crash-looping.
+
+    Call-site helpers (`maybe_raise`, `maybe_stall`, `maybe_exit`,
+    `corrupt_file`) keep injection one line in production code. When the
+    env var is unset `_sites` is empty and `fire()` is a single falsy
+    dict check — the zero-cost-when-off contract."""
+
+    def __init__(self):
+        self._sites: dict[str, dict] = {}
+        self._dir = ""
+        self._lock = threading.Lock()
+
+    def load_env(self) -> None:
+        self.configure(os.environ.get("CAFFE_TPU_FAULTS", ""),
+                       once_dir=os.environ.get("CAFFE_TPU_FAULTS_DIR", ""))
+
+    def configure(self, spec: str, once_dir: str = "") -> None:
+        self._dir = once_dir
+        self._sites = {}
+        for entry in (spec or "").split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            parts = entry.split(":")
+            site = parts[0]
+            count = int(parts[1]) if len(parts) > 1 and parts[1] else 1
+            skip = int(parts[2]) if len(parts) > 2 and parts[2] else 0
+            arg = parts[3] if len(parts) > 3 else ""
+            if self._done_path(site) and os.path.exists(
+                    self._done_path(site)):
+                log.info("fault site %r already fired in a previous "
+                         "process; disabled", site)
+                continue
+            self._sites[site] = {"count": count, "skip": skip, "arg": arg}
+
+    def _done_path(self, site: str) -> str:
+        return os.path.join(self._dir, f"{site}.done") if self._dir else ""
+
+    def fire(self, site: str, key: float | None = None) -> str | None:
+        """Returns the site's arg string when this call should fail,
+        else None. `key` (e.g. the current iteration) gates sites whose
+        arg is a numeric threshold: they fire only once key >= arg."""
+        if not self._sites:
+            return None
+        with self._lock:
+            st = self._sites.get(site)
+            if st is None:
+                return None
+            arg = st["arg"]
+            if key is not None and arg:
+                try:
+                    if key < float(arg):
+                        return None
+                except ValueError:
+                    pass  # non-numeric arg: no threshold gating
+            if st["skip"] > 0:
+                st["skip"] -= 1
+                return None
+            if st["count"] <= 0:  # sticky: every call, this process only
+                if not st.get("fired"):
+                    st["fired"] = True
+                    self._mark_done(site)
+                return arg
+            st["count"] -= 1
+            if st["count"] <= 0:
+                del self._sites[site]
+                self._mark_done(site)
+            return arg
+
+    def _mark_done(self, site: str) -> None:
+        done = self._done_path(site)
+        if done:
+            try:
+                with open(done, "w") as f:
+                    f.write(f"{time.time()}\n")
+            except OSError:
+                pass
+
+    # -- one-line call-site helpers ------------------------------------
+    def maybe_raise(self, site: str, exc_type=OSError, msg: str = "",
+                    key: float | None = None) -> None:
+        arg = self.fire(site, key=key)
+        if arg is not None:
+            raise exc_type(msg or f"injected fault at site {site!r}")
+
+    def maybe_stall(self, site: str, key: float | None = None) -> None:
+        arg = self.fire(site, key=key)
+        if arg is not None:
+            secs = float(arg or 30.0)
+            log.warning("fault plane: stalling %.1fs at site %r", secs, site)
+            time.sleep(secs)
+
+    def maybe_exit(self, site: str, key: float | None = None) -> None:
+        arg = self.fire(site, key=key)
+        if arg is not None:
+            log.warning("fault plane: hard exit at site %r", site)
+            sys.stderr.flush()
+            os._exit(EXIT_FAULT)
+
+    def corrupt_file(self, site: str, path: str) -> None:
+        """Flip one mid-file byte (bitrot/torn-write simulation)."""
+        if self.fire(site) is None:
+            return
+        with open(path, "r+b") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(size // 2, 0))
+            b = f.read(1)
+            f.seek(max(size // 2, 0))
+            f.write(bytes([(b[0] ^ 0xFF) if b else 0xFF]))
+        log.warning("fault plane: corrupted %s at site %r", path, site)
+
+
+FAULTS = FaultPlane()
+FAULTS.load_env()
+
+
+# ---------------------------------------------------------------------------
+# Atomic file publication + crc32c integrity
+# ---------------------------------------------------------------------------
+
+@contextmanager
+def atomic_output(path: str):
+    """Yield a temp path for the caller to write; on clean exit fsync it
+    and `os.replace` onto `path` (atomic on POSIX), so readers — and the
+    resume scan after a mid-write kill — only ever see absent-or-complete
+    files. On error the temp file is removed.
+
+    Stale temps from a previous writer killed mid-write (the pid suffix
+    differs) are swept first — writers to one path are serialized
+    (wait_snapshots), so anything matching is an orphan."""
+    import glob as _glob
+    for stale in _glob.glob(f"{glob_escape(path)}.tmp*"):
+        try:
+            os.unlink(stale)
+        except OSError:
+            pass
+    tmp = f"{path}.tmp{os.getpid()}"
+    try:
+        yield tmp
+        fd = os.open(tmp, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def crc32c_file(path: str, chunk: int = 1 << 22) -> int:
+    """Streaming crc32c of a file — hardware-accelerated via
+    google_crc32c when installed, else the repo's slice-by-8 table path
+    (data/leveldb_io.py)."""
+    try:
+        from google_crc32c import extend as _extend
+    except ImportError:
+        _extend = None
+    if _extend is None:
+        from ..data.leveldb_io import crc32c
+        with open(path, "rb") as f:
+            return crc32c(f.read())
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk)
+            if not buf:
+                return crc
+            crc = _extend(crc, buf)
+
+
+# ---------------------------------------------------------------------------
+# Snapshot manifests: write / verify / scan / GC
+# ---------------------------------------------------------------------------
+
+class SnapshotCorruptError(RuntimeError):
+    """A snapshot file failed its manifest crc32c check."""
+
+
+def manifest_for_state(state_path: str) -> str | None:
+    """Sidecar manifest path for a .solverstate[.h5]; None for formats
+    without a manifest scheme (.npz pre-interop, .orbax native)."""
+    for suf in _STATE_SUFFIXES:
+        if state_path.endswith(suf):
+            return state_path[: -len(suf)] + _MANIFEST_SUFFIX
+    return None
+
+
+def write_snapshot_manifest(state_path: str, it: int,
+                            files: dict[str, str]) -> str:
+    """Publish the integrity manifest for one snapshot — written LAST
+    (after every file it covers), atomically, so its existence is the
+    commit point of the whole snapshot. `files` maps role (model/state)
+    to path; stored as basenames relative to the manifest's directory."""
+    mpath = manifest_for_state(state_path)
+    if mpath is None:
+        raise ValueError(f"no manifest scheme for {state_path!r}")
+    entries = {}
+    for role, path in files.items():
+        entries[role] = {
+            "file": os.path.basename(path),
+            "size": os.path.getsize(path),
+            "crc32c": f"{crc32c_file(path):08x}",
+        }
+    doc = {"schema": _MANIFEST_SCHEMA, "iteration": int(it),
+           "time": time.time(), "files": entries}
+    with atomic_output(mpath) as tmp:
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+    return mpath
+
+
+def verify_snapshot(manifest_path: str) -> dict | None:
+    """Re-check every file the manifest covers against its recorded size
+    and crc32c. Returns the manifest dict (with a resolved 'state' path)
+    on success, None on any mismatch / missing file / unreadable
+    manifest — callers treat None as 'fall back to an older snapshot'."""
+    try:
+        with open(manifest_path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    base = os.path.dirname(os.path.abspath(manifest_path))
+    state_path = None
+    for role, ent in doc.get("files", {}).items():
+        path = os.path.join(base, ent["file"])
+        try:
+            if os.path.getsize(path) != ent["size"]:
+                return None
+            if f"{crc32c_file(path):08x}" != ent["crc32c"]:
+                return None
+        except OSError:
+            return None
+        if role == "state":
+            state_path = path
+    if state_path is None:
+        return None
+    doc["state"] = state_path
+    doc["manifest"] = os.path.abspath(manifest_path)
+    return doc
+
+
+def iter_snapshot_manifests(prefix: str) -> list[tuple[int, str]]:
+    """All `<prefix>_iter_<N>.manifest.json` sidecars, newest iteration
+    first. Pure directory listing — no file reads, no verification."""
+    d = os.path.dirname(prefix) or "."
+    stem = os.path.basename(prefix) + "_iter_"
+    out = []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return []
+    for name in names:
+        if not (name.startswith(stem) and name.endswith(_MANIFEST_SUFFIX)):
+            continue
+        mid = name[len(stem):-len(_MANIFEST_SUFFIX)]
+        if mid.isdigit():
+            out.append((int(mid), os.path.join(d, name)))
+    out.sort(key=lambda p: p[0], reverse=True)
+    return out
+
+
+def latest_verified_snapshot(prefix: str,
+                             max_iter: int | None = None) -> dict | None:
+    """Newest snapshot (optionally strictly below `max_iter`) whose
+    manifest verifies; corrupt/incomplete candidates are logged and
+    skipped — the corruption-fallback half of the resume contract."""
+    for it, mpath in iter_snapshot_manifests(prefix):
+        if max_iter is not None and it >= max_iter:
+            continue
+        doc = verify_snapshot(mpath)
+        if doc is not None:
+            return doc
+        log.warning("snapshot manifest %s failed verification "
+                    "(corrupt or incomplete); trying an older snapshot",
+                    mpath)
+    return None
+
+
+def gc_snapshots(prefix: str, keep: int,
+                 assume_verified: str | None = None) -> list[str]:
+    """Delete snapshot file sets beyond the newest `keep` manifests,
+    never deleting the newest VERIFIED snapshot (if the newest `keep`
+    are all corrupt, the last-known-good survives the sweep so resume
+    always has somewhere to land). `assume_verified` names a manifest
+    the caller KNOWS is good (the one its own writer just published) so
+    the scan skips re-reading hundreds of MB it checksummed moments
+    ago. Returns removed paths."""
+    if keep <= 0:
+        return []
+    manifests = iter_snapshot_manifests(prefix)
+    if len(manifests) <= keep:
+        return []
+    assumed = os.path.abspath(assume_verified) if assume_verified else None
+    newest_verified = None
+    for _it, mpath in manifests:  # newest first; stop at the first good
+        if os.path.abspath(mpath) == assumed \
+                or verify_snapshot(mpath) is not None:
+            newest_verified = mpath
+            break
+    removed = []
+    base = os.path.dirname(prefix) or "."
+    for _it, mpath in manifests[keep:]:
+        if mpath == newest_verified:
+            continue
+        try:
+            with open(mpath) as f:
+                doc = json.load(f)
+            victims = [os.path.join(base, ent["file"])
+                       for ent in doc.get("files", {}).values()]
+        except (OSError, ValueError):
+            victims = []
+        for path in victims + [mpath]:
+            try:
+                os.unlink(path)
+                removed.append(path)
+            except OSError:
+                pass
+    if removed:
+        log.info("snapshot GC (keep=%d): removed %d file(s)", keep,
+                 len(removed))
+    return removed
+
+
+# ---------------------------------------------------------------------------
+# Run manifest — the journal the watchdog and supervisor share
+# ---------------------------------------------------------------------------
+
+def run_manifest_path(prefix: str) -> str:
+    return prefix + ".run.json"
+
+
+# the run manifest has CONCURRENT same-process writers — the async
+# snapshot-writer thread journals "snapshot" while the watchdog monitor
+# may journal a trip — and atomic_output's temp path is only pid-unique,
+# so unserialized writers would sweep each other's in-progress temp
+_RUN_MANIFEST_LOCK = threading.Lock()
+
+
+def write_run_manifest(prefix: str, **fields) -> str:
+    """Journal the run state (iteration, last verified snapshot, RNG
+    cursor, reason) next to the snapshots. Atomic: a crash mid-journal
+    leaves the previous journal intact. Called at every successful
+    snapshot and by the watchdog just before a hard exit (the lock
+    serializes those two threads)."""
+    path = run_manifest_path(prefix)
+    doc = {"schema": _MANIFEST_SCHEMA, "time": time.time(),
+           "pid": os.getpid(), **fields}
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with _RUN_MANIFEST_LOCK:
+        with atomic_output(path) as tmp:
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+    return path
+
+
+def read_run_manifest(prefix: str) -> dict | None:
+    try:
+        with open(run_manifest_path(prefix)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Dispatch watchdog
+# ---------------------------------------------------------------------------
+
+class DispatchWatchdog:
+    """Monitor thread that bounds device dispatch/harvest time.
+
+    The solver wraps every device-blocking region in `section(label)`;
+    the monitor wakes every `poll` seconds and, when the OLDEST open
+    section has been open longer than `deadline`, calls `on_timeout`
+    (the solver's run-state journaler) and hard-exits the process with
+    EXIT_WATCHDOG. A dead tunnel hangs inside C++ where no Python signal
+    can run (CLAUDE.md) — but this thread is already in Python, so
+    os._exit still works, converting an indefinite hang into a bounded,
+    journaled failure the supervisor restarts from.
+
+    `hard_exit=False` (tests) records the trip in `.tripped` and fires
+    `.tripped_event` instead of exiting. The deadline must exceed the
+    worst jit-compile a dispatch can trigger — compiles happen inside
+    dispatch sections and are legitimate multi-second stalls."""
+
+    def __init__(self, deadline: float, on_timeout=None, *,
+                 poll: float | None = None, hard_exit: bool = True):
+        self.deadline = float(deadline)
+        self.on_timeout = on_timeout
+        self.poll = poll if poll is not None else min(
+            max(self.deadline / 4.0, 0.05), 5.0)
+        self.hard_exit = hard_exit
+        self.tripped: tuple[str, float] | None = None
+        self.tripped_event = threading.Event()
+        self._lock = threading.Lock()
+        self._open: dict[int, tuple[str, float]] = {}
+        self._next = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="dispatch-watchdog")
+        self._thread.start()
+
+    @contextmanager
+    def section(self, label: str):
+        with self._lock:
+            token = self._next
+            self._next += 1
+            self._open[token] = (label, time.monotonic())
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._open.pop(token, None)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2 * self.poll + 1.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll):
+            now = time.monotonic()
+            with self._lock:
+                oldest = min(self._open.values(), key=lambda lt: lt[1],
+                             default=None)
+            if oldest is None:
+                continue
+            label, t0 = oldest
+            elapsed = now - t0
+            if elapsed <= self.deadline:
+                continue
+            log.error("watchdog: device %s exceeded %.1fs deadline "
+                      "(%.1fs elapsed) — journaling run state and "
+                      "hard-exiting %d", label, self.deadline, elapsed,
+                      EXIT_WATCHDOG)
+            try:
+                if self.on_timeout is not None:
+                    self.on_timeout(label, elapsed)
+            except Exception:
+                log.exception("watchdog: run-state journal failed")
+            self.tripped = (label, elapsed)
+            self.tripped_event.set()
+            if self.hard_exit:
+                logging.shutdown()
+                os._exit(EXIT_WATCHDOG)
+            return
+
+
+_NULL_SECTION = nullcontext()
+
+
+# ---------------------------------------------------------------------------
+# Bounded retry
+# ---------------------------------------------------------------------------
+
+def retrying(fn, *, attempts: int = 4, base_delay: float = 0.05,
+             max_delay: float = 2.0, exc_types=(OSError,),
+             desc: str = ""):
+    """Call `fn()` with bounded exponential backoff on transient errors.
+    The LAST failure propagates unchanged (bounded, not infinite — a
+    truly dead dataset must surface, and the supervisor owns restarts)."""
+    delay = base_delay
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except exc_types as e:
+            if attempt == attempts - 1:
+                raise
+            log.warning("transient failure%s (attempt %d/%d): %r; "
+                        "retrying in %.2fs",
+                        f" in {desc}" if desc else "", attempt + 1,
+                        attempts, e, delay)
+            time.sleep(delay)
+            delay = min(delay * 2, max_delay)
+
+
+# ---------------------------------------------------------------------------
+# Supervisor: contained child + exponential backoff + crash-loop guard
+# ---------------------------------------------------------------------------
+
+def supervise(first_cmd: list[str], resume_cmd: list[str],
+              max_restarts: int, *, failure_log: str,
+              env: dict | None = None, cwd: str | None = None,
+              deadline: float | None = None,
+              backoff_base: float = 1.0, backoff_cap: float = 60.0) -> int:
+    """Run a training child to completion, restarting on failure.
+
+    Attempt 0 runs `first_cmd`; every restart runs `resume_cmd` (which
+    carries `--resume auto`, so it lands on the newest verified
+    snapshot). Children run under utils/subproc.run_contained — own
+    process group, killpg'd on every supervisor exit path, so a
+    supervisor kill can't orphan a chip-claiming child. After
+    `max_restarts` failed restarts the crash-loop guard gives up with
+    the per-attempt record preserved in `failure_log`. Returns the last
+    child's exit code (0 on success, None->1 on deadline kill)."""
+    from .subproc import run_contained
+    os.makedirs(os.path.dirname(failure_log) or ".", exist_ok=True)
+    rc = 1
+    for attempt in range(max_restarts + 1):
+        cmd = first_cmd if attempt == 0 else resume_cmd
+        log.info("supervisor: attempt %d/%d: %s", attempt + 1,
+                 max_restarts + 1, " ".join(cmd))
+        t0 = time.time()
+        rc, out, err = run_contained(cmd, deadline, cwd=cwd, env=env,
+                                     echo=True)
+        dt = time.time() - t0
+        if rc == 0:
+            if attempt > 0:
+                log.info("supervisor: recovered after %d restart(s)",
+                         attempt)
+            return 0
+        reason = ("deadline" if rc is None else
+                  "watchdog" if rc == EXIT_WATCHDOG else f"exit {rc}")
+        with open(failure_log, "a") as f:
+            f.write(f"[{time.ctime()}] attempt {attempt + 1}: {reason} "
+                    f"after {dt:.1f}s: {' '.join(cmd)}\n")
+            tail = (out or "").strip().splitlines()[-20:] \
+                + (err or "").strip().splitlines()[-20:]
+            for line in tail:
+                f.write(f"    {line}\n")
+        if attempt >= max_restarts:
+            log.error("supervisor: crash-loop guard: %d failure(s); "
+                      "giving up (log: %s)", attempt + 1, failure_log)
+            break
+        delay = min(backoff_base * (2 ** attempt), backoff_cap)
+        log.warning("supervisor: child failed (%s); restarting from the "
+                    "newest verified snapshot in %.1fs", reason, delay)
+        time.sleep(delay)
+    return 1 if rc is None else rc

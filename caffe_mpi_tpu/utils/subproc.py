@@ -52,13 +52,22 @@ def _install_hooks():
             pass
 
 
-def run_contained(cmd: list[str], timeout: float, cwd: str | None = None,
-                  env: dict | None = None):
+def run_contained(cmd: list[str], timeout: float | None,
+                  cwd: str | None = None, env: dict | None = None,
+                  echo: bool = False, tail_lines: int = 400):
     """Run cmd in its own process group with a hard deadline.
 
     Returns (returncode|None, stdout, stderr) — returncode None means
     the deadline expired. The group is SIGKILLed and the child reaped on
     every exit path, including the parent being SIGTERM'd.
+
+    timeout=None disables the deadline (supervised training children:
+    the in-child dispatch watchdog owns hang detection there, and a
+    multi-hour run must not be killed by an arbitrary cap). echo=True
+    streams the child's output to this process's stdout/stderr as it
+    arrives (training logs stay live under supervision) while still
+    returning the last `tail_lines` lines of each — memory stays bounded
+    on runs that log for hours.
     """
     _install_hooks()
     # Mask the handled signals across Popen -> _ACTIVE.add: a SIGTERM
@@ -83,6 +92,9 @@ def run_contained(cmd: list[str], timeout: float, cwd: str | None = None,
         if prev_mask is not None:
             signal.pthread_sigmask(signal.SIG_SETMASK, prev_mask)
     try:
+        if echo:
+            rc, out, err = _pump_echo(proc, timeout, tail_lines)
+            return rc, out, err
         out, err = proc.communicate(timeout=timeout)
         return proc.returncode, out, err
     except subprocess.TimeoutExpired:
@@ -94,6 +106,45 @@ def run_contained(cmd: list[str], timeout: float, cwd: str | None = None,
         _kill_group(proc)
         proc.wait()
         _ACTIVE.discard(proc.pid)
+
+
+def _pump_echo(proc: subprocess.Popen, timeout: float | None,
+               tail_lines: int):
+    """Mirror the child's pipes to this process's streams line by line,
+    keeping only a bounded tail of each. Returns (rc|None, out_tail,
+    err_tail) — rc None means the deadline expired (group killed, same
+    contract as the communicate() path)."""
+    import sys
+    import threading
+    from collections import deque
+
+    tails = {"out": deque(maxlen=tail_lines), "err": deque(maxlen=tail_lines)}
+
+    def pump(pipe, sink, key):
+        for line in pipe:
+            tails[key].append(line)
+            sink.write(line)
+            sink.flush()
+
+    threads = [
+        threading.Thread(target=pump, args=(proc.stdout, sys.stdout, "out"),
+                         daemon=True),
+        threading.Thread(target=pump, args=(proc.stderr, sys.stderr, "err"),
+                         daemon=True),
+    ]
+    for t in threads:
+        t.start()
+    timed_out = False
+    try:
+        proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        timed_out = True
+        _kill_group(proc)
+        proc.wait()
+    for t in threads:  # pipes hit EOF once the group is dead
+        t.join(timeout=5)
+    return (None if timed_out else proc.returncode,
+            "".join(tails["out"]), "".join(tails["err"]))
 
 
 def _kill_group(proc: subprocess.Popen) -> None:

@@ -81,7 +81,56 @@ def main() -> int:
     p.add_argument("--test-chunk", type=int, default=4,
                    help="test batches fused per eval dispatch (solver "
                    "test_chunk)")
+    # survivable-training knobs (ISSUE 3, utils/resilience.py)
+    p.add_argument("--max-restarts", type=int, default=0,
+                   help="supervised mode: run this script in a contained "
+                   "child and restart it (--resume auto, exponential "
+                   "backoff) up to N times on failure — watchdog "
+                   "hard-exits included. 0 = unsupervised")
+    p.add_argument("--watchdog-deadline", type=float, default=0.0,
+                   help="dispatch watchdog deadline in seconds (journal "
+                   "+ hard-exit 86 on a stuck device sync); 0 = off")
+    p.add_argument("--snapshot-every", type=int, default=0,
+                   help="write a verified atomic snapshot every N "
+                   "iterations (0 = only useful under --max-restarts, "
+                   "where it defaults to 10)")
+    p.add_argument("--snapshot-keep", type=int, default=3,
+                   help="keep only the newest N snapshots (solver "
+                   "snapshot_keep GC; never deletes the newest "
+                   "verified one)")
+    p.add_argument("--resume", default="",
+                   help="'auto' = resume from the newest verified "
+                   "snapshot in the workdir (set by the supervisor on "
+                   "restart)")
     args = p.parse_args()
+
+    if args.max_restarts > 0 \
+            and os.environ.get("CAFFE_SUPERVISED_CHILD") != "1":
+        # supervisor half: contained child + exponential backoff +
+        # crash-loop guard; restarts resume from the newest verified
+        # snapshot (the same harness `cli train --max-restarts` uses)
+        from caffe_mpi_tpu.utils import resilience
+        argv, skip = [], False
+        for tok in sys.argv[1:]:  # child argv = ours minus --max-restarts
+            if skip:
+                skip = False
+                continue
+            if tok == "--max-restarts":
+                skip = True
+                continue
+            if tok.startswith("--max-restarts="):
+                continue
+            argv.append(tok)
+        base = [sys.executable, os.path.abspath(__file__)] + argv
+        resume = base + (["--resume", "auto"]
+                         if "--resume" not in argv
+                         and not any(a.startswith("--resume=")
+                                     for a in argv) else [])
+        env = dict(os.environ, CAFFE_SUPERVISED_CHILD="1")
+        prefix = os.path.join(args.workdir, "e2e_snap", "s")
+        return resilience.supervise(
+            base, resume, args.max_restarts,
+            failure_log=prefix + ".failures.log", env=env)
 
     os.makedirs(args.workdir, exist_ok=True)
     db, mean = build_db(args.workdir, args.records)
@@ -123,8 +172,20 @@ def main() -> int:
     # (= ceil(test_iter/test_chunk) + 1 param copy) and eval_stall_ms
     sp.test_iter = [args.test_iters]
     sp.test_chunk = max(args.test_chunk, 1)
+    # survivable training (ISSUE 3): verified atomic snapshots with GC,
+    # optional dispatch watchdog; the supervised restart lands on the
+    # newest verified snapshot via --resume auto
+    sp.snapshot_prefix = os.path.join(args.workdir, "e2e_snap", "s")
+    snap_every = args.snapshot_every or (
+        10 if os.environ.get("CAFFE_SUPERVISED_CHILD") == "1" else 0)
+    if snap_every:
+        sp.snapshot = snap_every
+    sp.snapshot_keep = max(args.snapshot_keep, 0)
+    sp.watchdog_deadline = max(args.watchdog_deadline, 0.0)
 
     solver = Solver(sp)
+    if args.resume == "auto":
+        solver.restore_auto()
     feeder = _build_feeders(solver.net, "TRAIN")
     assert feeder is not None, "Data layer did not produce a feeder"
     test_feeder = _build_feeders(solver.test_nets[0], "TEST")

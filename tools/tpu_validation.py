@@ -27,14 +27,14 @@ sys.path.insert(0, _ROOT)
 from caffe_mpi_tpu.utils.subproc import run_contained  # noqa: E402
 
 
-def run(name, cmd, timeout, log):
+def run(name, cmd, timeout, log, env=None):
     print(f"=== {name}: {' '.join(cmd)}", flush=True)
     t0 = time.time()
     # Own process group + killpg + reap on every exit path: a child left
     # behind (e.g. this script gets pkill'd, or a hang outlives the
     # timeout) keeps the single TPU chip CLAIMED and every later probe
     # times out looking exactly like a dead tunnel.
-    rc, out, err = run_contained(cmd, timeout, cwd=_ROOT)
+    rc, out, err = run_contained(cmd, timeout, cwd=_ROOT, env=env)
     if rc is None:
         ok, tail = False, [f"TIMEOUT after {timeout}s"]
     else:
@@ -131,6 +131,27 @@ for causal in (False, True):
                  "-solver", "models/lenet/lenet_solver.prototxt",
                  "-synthetic", "-max_iter", "200", "-gpu", "all"],
                 600, log)
+            # survivable training on real hardware (ISSUE 3): the fault
+            # plane kills the child at iter 60; the supervisor must
+            # restart it with --resume auto onto the newest VERIFIED
+            # snapshot and the run must still reach max_iter — watchdog
+            # armed throughout (a real tunnel death during this stage
+            # exits 86 and restarts the same way)
+            import shutil
+            wd = "/tmp/caffe_tpu_wd_resume"
+            shutil.rmtree(wd, ignore_errors=True)
+            os.makedirs(os.path.join(wd, "faults"))
+            env = dict(os.environ,
+                       CAFFE_TPU_FAULTS="train_abort:1:0:60",
+                       CAFFE_TPU_FAULTS_DIR=os.path.join(wd, "faults"))
+            run("watchdog-auto-resume",
+                [py, "-m", "caffe_mpi_tpu.tools.cli", "train",
+                 "-solver", "models/lenet/lenet_solver.prototxt",
+                 "-synthetic", "-max_iter", "120",
+                 "-snapshot_every", "40", "-snapshot_keep", "2",
+                 "-snapshot_prefix", os.path.join(wd, "snap"),
+                 "-max_restarts", "2", "-watchdog_deadline", "300"],
+                900, log, env=env)
             # flagship fed from a REAL LMDB through the host pipeline —
             # the e2e img/s vs the synthetic-feed bench quantifies the
             # pipeline cost on hardware (VERDICT r4 weak #3)

@@ -59,6 +59,15 @@ STEP_CHUNK = max(int(os.environ.get("CAFFE_BENCH_STEP_CHUNK", 10)), 1)
 # at test_chunk batches per eval dispatch
 EVAL_TEST_ITER = int(os.environ.get("CAFFE_BENCH_TEST_ITER", 8))
 EVAL_TEST_CHUNK = int(os.environ.get("CAFFE_BENCH_TEST_CHUNK", 4))
+# CAFFE_BENCH_GUARD: the on-device non-finite guard (ISSUE 4,
+# solver train_guard). Default ON for the headline so the "guard is
+# ~free on device" claim is what the committed number actually
+# measures — the same program with per-step finiteness selects in the
+# scan. skipped_steps / guard_syncs in the JSON are the CPU-visible
+# proxies (0 skips expected on synthetic data; guard_syncs = chunk
+# boundaries, each a 5-scalar transfer). Set 0 for the unguarded
+# program (renames the metric like every other knob).
+GUARD = os.environ.get("CAFFE_BENCH_GUARD", "1") != "0"
 _SOLVERS = {
     ("alexnet", "f32"): "models/alexnet/solver.prototxt",
     ("alexnet", "bf16"): "models/alexnet/solver_fp16.prototxt",
@@ -66,11 +75,11 @@ _SOLVERS = {
     ("resnet50", "bf16"): "models/resnet50/solver_fp16.prototxt",
 }
 _IS_DEBUG = (BATCH, ITERS, WARMUP, MODEL, DTYPE, STEP_CHUNK,
-             EVAL_TEST_ITER, EVAL_TEST_CHUNK) != (
-                 256, 20, 3, "alexnet", "f32", 10, 8, 4)
+             EVAL_TEST_ITER, EVAL_TEST_CHUNK, GUARD) != (
+                 256, 20, 3, "alexnet", "f32", 10, 8, 4, True)
 METRIC = ("alexnet_b256_train_img_per_s_1chip" if not _IS_DEBUG
           else f"debug_{MODEL}_{DTYPE}_b{BATCH}_i{ITERS}_k{STEP_CHUNK}"
-               "_train_img_per_s_1chip")
+               f"{'' if GUARD else '_noguard'}_train_img_per_s_1chip")
 
 
 def emit(value=None, vs_baseline=None, extra=None, error=None):
@@ -125,6 +134,7 @@ def run_bench():
     sp.snapshot = 0
     sp.test_interval = 0
     sp.step_chunk = STEP_CHUNK
+    sp.train_guard = GUARD
     from caffe_mpi_tpu.utils.model_shapes import input_shapes, synthetic_feeds
     npar = NetParameter.from_file(os.path.join(_ROOT, sp.net))
     shapes = input_shapes(npar, batch=BATCH)
@@ -143,12 +153,14 @@ def run_bench():
     jax.block_until_ready(solver.params)
 
     d0, s0 = solver.dispatch_count, solver.host_sync_count
+    g0 = solver.guard_sync_count
     t0 = time.perf_counter()
     solver.step(ITERS, feed_fn)
     jax.block_until_ready(solver.params)
     dt = time.perf_counter() - t0
     dispatches = solver.dispatch_count - d0
     host_syncs = solver.host_sync_count - s0
+    guard_syncs = solver.guard_sync_count - g0
 
     img_s = BATCH * ITERS / dt
     flops_img = train_flops_per_image(solver.net)
@@ -202,6 +214,14 @@ def run_bench():
         # 0 in the headline config (display off): the timed region never
         # blocks on the device between chunks
         "host_syncs": host_syncs,
+        # self-healing guard telemetry (ISSUE 4): skipped_steps must be
+        # 0 on synthetic data (any other value is itself a finding);
+        # guard_syncs counts the per-chunk 5-scalar counter reads — the
+        # guard's ONLY host traffic, so "~free on device" is measured
+        # by comparing this line against CAFFE_BENCH_GUARD=0
+        "train_guard": sp.train_guard,
+        "skipped_steps": solver.skipped_steps,
+        "guard_syncs": guard_syncs,
     }
     extra.update(eval_extra)
     return round(img_s, 1), round(img_s / BASELINE_IMG_S, 2), extra

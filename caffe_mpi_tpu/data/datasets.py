@@ -25,6 +25,8 @@ from typing import Protocol
 import numpy as np
 
 from ..io import _tag as _dfield, _varint as _dvarint
+from ..utils.resilience import FAULTS, RecordIntegrityError
+from .lmdb_io import LMDBError as LMDBIOError
 
 
 class Dataset(Protocol):
@@ -137,6 +139,43 @@ def encode_datum_float(arr: np.ndarray, label: int) -> bytes:
 
 
 # ---------------------------------------------------------------------------
+# Read-path integrity (ISSUE 4 data-integrity plane)
+# ---------------------------------------------------------------------------
+
+def _decode_verified(raw: bytes, index: int, source: str,
+                     expect_crc: int | None = None,
+                     actual_crc: int | None = None):
+    """Datum decode with integrity verification. `expect_crc` (from the
+    LMDB crc sidecar / a format-level checksum) is compared against
+    `actual_crc` — computed here over the fetched bytes when the caller
+    did not already have one (the native LMDB path computes it in C
+    over the mmap). Any mismatch or parse failure raises
+    RecordIntegrityError, the deterministic-corruption signal the
+    Feeder quarantines on (transient I/O errors stay OSError and keep
+    their retry budget). The fault sites operate on the FETCHED bytes,
+    zero cost when CAFFE_TPU_FAULTS is unset."""
+    if FAULTS.active("record_corrupt") or FAULTS.active("record_decode"):
+        poisoned = FAULTS.corrupt_bytes("record_corrupt", raw, index)
+        poisoned = FAULTS.corrupt_bytes("record_decode", poisoned, index)
+        if poisoned is not raw:
+            raw, actual_crc = poisoned, None  # re-checksum injected rot
+    if expect_crc is not None:
+        if actual_crc is None:
+            from .leveldb_io import crc32c
+            actual_crc = crc32c(raw)
+        if actual_crc != expect_crc:
+            raise RecordIntegrityError(
+                source, index,
+                f"crc32c mismatch (sidecar {expect_crc:08x}, "
+                f"computed {actual_crc:08x})")
+    try:
+        return parse_datum(raw)
+    except Exception as e:
+        raise RecordIntegrityError(
+            source, index, f"undecodable Datum: {e!r}") from e
+
+
+# ---------------------------------------------------------------------------
 # Backends
 # ---------------------------------------------------------------------------
 
@@ -144,21 +183,36 @@ class LMDBDataset:
     """Reads LMDBs written by the reference's convert_imageset
     (db_lmdb.cpp). Uses the python `lmdb` module when present, else the
     in-repo dependency-free B+tree reader (data/lmdb_io.py) — either way,
-    reference-written LMDBs load unchanged."""
+    reference-written LMDBs load unchanged.
+
+    Integrity (ISSUE 4): when the crc sidecar our writers publish
+    (`data.mdb.crc32c`, data/lmdb_io.py) is present, every value read
+    — on all three cursor paths — verifies against its per-record
+    crc32c; a mismatch raises RecordIntegrityError for the Feeder to
+    quarantine. Sidecar-less (reference-written) DBs load unverified,
+    as before; undecodable Datums quarantine either way."""
 
     def __init__(self, path: str):
         try:
             import lmdb
         except ImportError:
             lmdb = None
+        self.path = path
         self.env = None
         self._reader = None
         self._native = None
+        self._crcs = None
+        # structural-corruption classes the get() path converts to the
+        # quarantine signal — the lmdb module's own error hierarchy
+        # joins in when that cursor is the one in use
+        self._struct_errs: tuple = (LMDBIOError,)
         if lmdb is not None:
+            self._struct_errs = (LMDBIOError, lmdb.Error)
             self.env = lmdb.open(path, readonly=True, lock=False,
                                  readahead=False, meminit=False)
             with self.env.begin() as txn:
                 self.keys = [k for k, _ in txn.cursor()]
+            self._load_sidecar(path)
             return
         try:  # native C++ mmap cursor when built
             from .. import native
@@ -167,40 +221,78 @@ class LMDBDataset:
                 # key-only scan: values stay untouched in the mmap
                 self.keys = [self._native.key(i)
                              for i in range(len(self._native))]
+                self._load_sidecar(path)
                 return
         except (ImportError, ValueError, RuntimeError):
             self._native = None
         from .lmdb_io import LMDBReader
         self._reader = LMDBReader(path)
         self.keys = list(self._reader.keys())
+        self._load_sidecar(path)
+
+    def _load_sidecar(self, path: str) -> None:
+        from .lmdb_io import read_crc_sidecar
+        self._crcs = read_crc_sidecar(path, expect_count=len(self.keys))
 
     def __len__(self) -> int:
         return len(self.keys)
 
     def get(self, index: int) -> tuple[np.ndarray, int]:
+        expect = int(self._crcs[index]) if self._crcs is not None else None
         if self._native is not None:
-            return parse_datum(self._native.value(index))
-        if self._reader is not None:
-            return parse_datum(self._reader.get(self.keys[index]))
-        with self.env.begin() as txn:
-            return parse_datum(txn.get(self.keys[index]))
+            raw = self._native.value(index)
+            # the C path checksums the value over the mmap — no second
+            # pass over the bytes in Python (skipped while fault
+            # injection is live: the injected rot lands on the FETCHED
+            # copy, which the C reader cannot see)
+            actual = (self._native.value_crc32c(index)
+                      if expect is not None and not FAULTS.active(
+                          "record_corrupt")
+                      and not FAULTS.active("record_decode") else None)
+            return _decode_verified(raw, index, self.path, expect, actual)
+        try:
+            if self._reader is not None:
+                raw = self._reader.get(self.keys[index])
+            else:
+                with self.env.begin() as txn:
+                    raw = txn.get(self.keys[index])
+        except self._struct_errs as e:
+            # structural rot (bad page flags, value beyond EOF): same
+            # quarantine signal as a checksum mismatch
+            raise RecordIntegrityError(self.path, index,
+                                       f"structural: {e}") from e
+        return _decode_verified(raw, index, self.path, expect)
 
 
 class LevelDBDataset:
     """Reads LevelDB datasets written by the reference's convert tools
     (db_leveldb.cpp) via the dependency-free SSTable reader
-    (data/leveldb_io.py): all tables merged, key order, Datum values."""
+    (data/leveldb_io.py): all tables merged, key order, Datum values.
+
+    Integrity (ISSUE 4): the SSTable format carries a masked crc32c per
+    block, computed by every writer; the reader now verifies it on each
+    block decode (leveldb_io._Table.read_block), so value fetches from
+    a rotten block raise — converted here to RecordIntegrityError for
+    the Feeder's quarantine. Undecodable Datums quarantine the same
+    way."""
 
     def __init__(self, path: str):
         from .leveldb_io import LevelDBReader
+        self.path = path
         self._reader = LevelDBReader(path)
 
     def __len__(self) -> int:
         return len(self._reader)
 
     def get(self, index: int) -> tuple[np.ndarray, int]:
-        # positional: values decode on demand from the mmap'd tables
-        return parse_datum(self._reader.value_at(index))
+        from .leveldb_io import LevelDBError
+        try:
+            # positional: values decode on demand from the mmap'd
+            # tables, each block crc32c-verified on read
+            raw = self._reader.value_at(index)
+        except LevelDBError as e:
+            raise RecordIntegrityError(self.path, index, str(e)) from e
+        return _decode_verified(raw, index, self.path)
 
 
 class ImageFolderDataset:
@@ -338,6 +430,7 @@ class DatumFileDataset:
     MAGIC = b"CAFFEDATUMv1"
 
     def __init__(self, path: str):
+        self.path = path  # names the file in quarantine journal entries
         self.f = open(path, "rb")
         self._fd = self.f.fileno()
         header = self.f.read(len(self.MAGIC))
@@ -355,7 +448,8 @@ class DatumFileDataset:
     def get(self, index: int) -> tuple[np.ndarray, int]:
         off, size = self.offsets[index]
         # pread: positioned read, safe under the Feeder's concurrent threads
-        return parse_datum(os.pread(self._fd, int(size), int(off)))
+        return _decode_verified(os.pread(self._fd, int(size), int(off)),
+                                index, self.f.name)
 
     @classmethod
     def write(cls, path: str, records) -> int:

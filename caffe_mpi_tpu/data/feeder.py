@@ -29,13 +29,20 @@ from concurrent.futures import Future, ThreadPoolExecutor
 
 import numpy as np
 
-from ..utils.resilience import FAULTS, retrying
+from ..utils.resilience import (FAULTS, QUARANTINE, DataIntegrityError,
+                                RecordIntegrityError, retrying)
 from .datasets import Dataset
 from .transformer import DataTransformer
 
 log = logging.getLogger("caffe_mpi_tpu.feeder")
 
 _LOOKAHEAD_HARD_CAP = 16  # queue-depth ceiling even with RAM to spare
+# quarantine plane (ISSUE 4): how many successive substitute records to
+# probe past a corrupt one before declaring the neighborhood dead, and
+# the distinct-record bound past which corruption counts as systematic
+# (dataset-level) rather than record-level
+_QUARANTINE_PROBES = 16
+_QUARANTINE_MAX_FRACTION = 0.05
 
 
 class FeedError(RuntimeError):
@@ -124,6 +131,16 @@ class Feeder:
             raise ValueError("empty dataset")
         self._size = n
         self._perm_cache: dict[int, np.ndarray] = {}
+        # quarantine plane: distinct corrupt records substituted so far
+        # (set membership drives the bounded-ratio hard failure);
+        # guarded by _lock — pool workers quarantine concurrently
+        self._quarantined: set[int] = set()
+        # rec -> substitute memo: substitution is a pure function of
+        # the record index, so after the first discovery later epochs
+        # read the substitute directly (no re-read + re-checksum of
+        # the known-corrupt record, no re-probing)
+        self._sub_cache: dict[int, int] = {}
+        self._quarantine_limit = max(4, int(n * _QUARANTINE_MAX_FRACTION))
 
     # ------------------------------------------------------------------
     def _record_index(self, it: int, slot: int) -> int:
@@ -161,7 +178,10 @@ class Feeder:
         errors (NFS blips, DB cursor hiccups — and the injected
         `feeder_read` fault) are absorbed up to the attempt budget; a
         persistent failure surfaces to the consumer with the record
-        named, where the supervisor owns the restart."""
+        named, where the supervisor owns the restart. Integrity
+        failures (RecordIntegrityError — crc mismatch, structural DB
+        rot, undecodable Datum) are DETERMINISTIC: they bypass the
+        retry budget and quarantine instead (_read_record_verified)."""
         def get():
             FAULTS.maybe_raise("feeder_read", OSError,
                                f"injected dataset read fault (record {rec})")
@@ -169,11 +189,65 @@ class Feeder:
         return retrying(get, attempts=4, base_delay=0.05,
                         desc=f"dataset read (record {rec})")
 
+    def _read_record_verified(self, rec: int):
+        """Read record `rec`, quarantining it on an integrity failure
+        (ISSUE 4): the substitute is the next healthy record by index —
+        `(rec + probe) % size`, probe = 1.. — a pure function of `rec`
+        (itself a pure function of the iteration index), so a resumed
+        or replayed run makes IDENTICAL substitution decisions and
+        stays iteration-exact. Each newly quarantined record is
+        journaled to `<prefix>.quarantine.json`; more than
+        `_quarantine_limit` distinct corrupt records (or a fully
+        corrupt probe window) is systematic corruption and raises
+        DataIntegrityError — a hard, named failure instead of silently
+        training on substitutes."""
+        sub = self._sub_cache.get(rec)
+        if sub is not None:
+            # recurse: if the memoized substitute has ITSELF rotted
+            # since, it gets quarantined like any primary record
+            # (depth bounded by the quarantine limit)
+            return self._read_record_verified(sub)
+        try:
+            return self._read_record(rec)
+        except RecordIntegrityError as first:
+            src = getattr(self.ds, "path", "") or type(self.ds).__name__
+            with self._lock:
+                self._quarantined.add(rec)
+                n_bad = len(self._quarantined)
+            if n_bad > self._quarantine_limit:
+                raise DataIntegrityError(
+                    f"{n_bad} distinct corrupt records in {src} exceeds "
+                    f"the quarantine bound ({self._quarantine_limit} = "
+                    f"{_QUARANTINE_MAX_FRACTION:.0%} of {self._size}); "
+                    "corruption is systematic — regenerate the dataset "
+                    f"(first failure: {first})") from first
+            for probe in range(1, _QUARANTINE_PROBES + 1):
+                sub = (rec + probe) % self._size
+                try:
+                    out = self._read_record(sub)
+                except RecordIntegrityError as e:
+                    with self._lock:
+                        self._quarantined.add(sub)
+                    # probe casualties count toward the systematic
+                    # bound, so they must appear in the audit journal
+                    # too (substitute -1 = "skipped during probing")
+                    QUARANTINE.record(src, sub, -1, e.reason)
+                    continue
+                QUARANTINE.record(src, rec, sub, first.reason)
+                with self._lock:
+                    self._sub_cache[rec] = sub
+                return out
+            raise DataIntegrityError(
+                f"records {rec}..{(rec + _QUARANTINE_PROBES) % self._size}"
+                f" of {src} are ALL corrupt ({_QUARANTINE_PROBES + 1} "
+                "consecutive); corruption is systematic — regenerate "
+                f"the dataset (first failure: {first})") from first
+
     def _build_batch_inner(self, it: int) -> dict[str, np.ndarray]:
         raws, labels, flats = [], [], []
         for slot in range(self.batch):
             rec = self._record_index(it, slot)
-            img, label = self._read_record(rec)
+            img, label = self._read_record_verified(rec)
             raws.append(img)
             labels.append(label)
             flats.append(it * self.batch * self.world

@@ -50,6 +50,14 @@ def _load():
         ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64)]
     lib.caffe_tpu_lmdb_close.restype = None
     lib.caffe_tpu_lmdb_close.argtypes = [ctypes.c_void_p]
+    # added with ISSUE 4; a pre-existing .so without the symbol still
+    # loads (python-side crc32c is the fallback)
+    try:
+        lib.caffe_tpu_lmdb_value_crc32c.restype = ctypes.c_int64
+        lib.caffe_tpu_lmdb_value_crc32c.argtypes = [ctypes.c_void_p,
+                                                    ctypes.c_int64]
+    except AttributeError:
+        pass
     lib.caffe_tpu_transform_batch.restype = ctypes.c_int
     lib.caffe_tpu_transform_batch.argtypes = [
         ctypes.POINTER(ctypes.c_void_p),          # srcs
@@ -162,6 +170,19 @@ class NativeLMDB:
     def value(self, index: int) -> bytes:
         _kp, _kl, vp, vl = self._locate(index)
         return ctypes.string_at(vp, vl.value)
+
+    def value_crc32c(self, index: int) -> int | None:
+        """crc32c of the value bytes, computed in C over the mmap (no
+        bytes copied into Python) — the native half of the read-path
+        integrity check. None when the loaded .so predates the
+        symbol."""
+        fn = getattr(self._lib, "caffe_tpu_lmdb_value_crc32c", None)
+        if fn is None:
+            return None
+        crc = fn(self._h, index)
+        if crc < 0:
+            raise IndexError(index)
+        return int(crc)
 
     def close(self) -> None:
         if self._h:

@@ -51,6 +51,43 @@ inline uint16_t u16(const uint8_t* p) { uint16_t v; memcpy(&v, p, 2); return v; 
 inline uint32_t u32(const uint8_t* p) { uint32_t v; memcpy(&v, p, 4); return v; }
 inline uint64_t u64(const uint8_t* p) { uint64_t v; memcpy(&v, p, 8); return v; }
 
+// crc32c (Castagnoli), slice-by-8 — the record-integrity checksum the
+// crc sidecar scheme verifies on the read path (ISSUE 4). Same
+// polynomial/table construction as data/leveldb_io.py's python
+// fallback; computed here directly over the mmap so the native value
+// path verifies without first copying the bytes into Python.
+struct Crc32cTables {
+  uint32_t t[8][256];
+  Crc32cTables() {
+    const uint32_t poly = 0x82F63B78u;
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k) c = (c & 1) ? (c >> 1) ^ poly : c >> 1;
+      t[0][i] = c;
+    }
+    for (int k = 1; k < 8; ++k)
+      for (uint32_t i = 0; i < 256; ++i)
+        t[k][i] = (t[k - 1][i] >> 8) ^ t[0][t[k - 1][i] & 0xFF];
+  }
+};
+
+const Crc32cTables kCrc;
+
+uint32_t crc32c(const uint8_t* p, size_t n) {
+  uint32_t crc = 0xFFFFFFFFu;
+  size_t n8 = n - (n % 8);
+  for (size_t i = 0; i < n8; i += 8) {
+    crc ^= u32(p + i);
+    crc = kCrc.t[7][crc & 0xFF] ^ kCrc.t[6][(crc >> 8) & 0xFF] ^
+          kCrc.t[5][(crc >> 16) & 0xFF] ^ kCrc.t[4][crc >> 24] ^
+          kCrc.t[3][p[i + 4]] ^ kCrc.t[2][p[i + 5]] ^
+          kCrc.t[1][p[i + 6]] ^ kCrc.t[0][p[i + 7]];
+  }
+  for (size_t i = n8; i < n; ++i)
+    crc = kCrc.t[0][(crc ^ p[i]) & 0xFF] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
+
 // meta page -> (ok, psize, root, txnid)
 bool parse_meta(const uint8_t* base, size_t len, size_t off, size_t* psize,
                 uint64_t* root, uint64_t* txnid) {
@@ -176,6 +213,18 @@ int caffe_tpu_lmdb_record(void* h, int64_t idx, const uint8_t** key,
   *val = r.val;
   *vlen = r.vlen;
   return 0;
+}
+
+// crc32c of record `idx`'s VALUE bytes, computed over the mapping
+// (zero-copy) — the read-path integrity check against the crc sidecar
+// (data/lmdb_io.py write_crc_sidecar). Returns -1 on a bad handle/index
+// so the int64 return can carry the full u32 range.
+int64_t caffe_tpu_lmdb_value_crc32c(void* h, int64_t idx) {
+  if (!h) return -1;
+  auto* db = (LmdbDB*)h;
+  if (idx < 0 || idx >= (int64_t)db->recs.size()) return -1;
+  const Rec& r = db->recs[(size_t)idx];
+  return (int64_t)crc32c(r.val, (size_t)r.vlen);
 }
 
 void caffe_tpu_lmdb_close(void* h) {

@@ -956,6 +956,35 @@ class SolverParameter(Message):
     # always have somewhere to land). 0 (default) = keep everything,
     # the reference behavior.
     snapshot_keep: int = 0
+    # TPU-native extension (ISSUE 4, self-healing training): on-device
+    # non-finite guard inside the (fused) train step. When true, an
+    # all-finite reduction over loss + gradients selects per step
+    # between applying the optimizer update and keeping params /
+    # momentum / BN state unchanged (skip-step) — zero extra dispatches,
+    # the decision and its counters live in the scan carry. false
+    # (default) = today's behavior, bitwise.
+    train_guard: bool = False
+    # consecutive skipped steps before the run declares numeric
+    # divergence: journals the anomaly to <prefix>.run.json and exits
+    # code 88 (EXIT_NUMERIC) so the --max-restarts supervisor can apply
+    # anomaly_action. 0 = never exit (skip forever, counters only).
+    guard_max_skips: int = 3
+    # on-device loss-spike detector: >0 also skips a step whose loss
+    # exceeds guard_loss_spike x the carried loss EMA (a divergence that
+    # never goes non-finite). 0 (default) = finiteness checks only.
+    guard_loss_spike: float = 0.0
+    # decay of the loss EMA the spike detector compares against; the EMA
+    # only absorbs ACCEPTED steps, so a diverging tail can't drag the
+    # baseline up after it.
+    guard_ema_decay: float = 0.9
+    # what the supervisor does when the child exits 88:
+    #   rewind    — restart from the newest verified snapshot (default)
+    #   rewind_lr — rewind AND scale base_lr by anomaly_lr_mult per
+    #               numeric restart (compounding), to step around the
+    #               divergence instead of replaying into it
+    #   abort     — treat divergence as fatal: no restart, exit 88
+    anomaly_action: str = "rewind"
+    anomaly_lr_mult: float = 0.1
     # TPU-native extension (ISSUE 3): dispatch watchdog deadline in
     # seconds. >0 arms a monitor thread that journals the run state and
     # hard-exits (exit code 86) when any device dispatch/harvest blocks

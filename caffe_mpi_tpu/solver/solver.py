@@ -254,6 +254,28 @@ class Solver:
         self._watchdog = None
         self._last_snapshot: tuple[int, str] | None = None
         self._snapshot_error: tuple[int, BaseException] | None = None
+        # self-healing state (ISSUE 4): the on-device non-finite guard.
+        # _gstate is the guard carry (skip counter, consecutive-skip
+        # counter, longest-burst-this-dispatch, last-bad-iteration,
+        # loss EMA) — five device scalars threaded through both train
+        # entry points when train_guard is on; _guard_prev defers the
+        # host-side divergence check by one
+        # dispatch so the async pipeline never blocks on the chunk it
+        # just launched. skipped_steps / guard_sync_count are the
+        # CPU-visible telemetry bench.py reports (the "guard is ~free"
+        # claim is measured, not asserted).
+        self._guard_on = bool(getattr(sp, "train_guard", False))
+        if self._guard_on and self._gpipe_cfg is not None:
+            raise ValueError(
+                "train_guard is unsupported under gpipe (the guard "
+                "select lives inside the SPMD step; pipeline stages "
+                "update per-device)")
+        self._gstate = None
+        self._guard_prev: tuple[int, dict] | None = None
+        self._guard_unchecked = 0
+        self.skipped_steps = 0
+        self.guard_sync_count = 0
+        self._fault_feed_cache: tuple | None = None
         self._grad_transform = grad_transform
         # decls (lr_mult/decay_mult per param) in pytree-congruent form
         self._decls = {
@@ -365,7 +387,17 @@ class Solver:
         `lax.scan` body of the K-step fused program (_build_multi_step).
         One definition means the two modes are numerically the same
         computation — the equivalence suite (tests/test_multistep.py)
-        holds them to f32 tolerance."""
+        holds them to f32 tolerance.
+
+        With `train_guard` on (ISSUE 4) the signature grows a trailing
+        guard-carry dict and return: after the update is computed, an
+        all-finite reduction over loss + the updated params/opt/BN
+        state (plus the optional loss-spike check against the carried
+        EMA) selects per step between the freshly computed state and
+        the unchanged inputs — a skip-step, decided entirely on
+        device. On an accepted step the selects pass the exact
+        computed arrays through, so guard-on training on clean data
+        stays BITWISE equal to guard-off (tests/test_train_guard.py)."""
         sp = self.sp
         net = self.net
         update_fn = self.update_fn
@@ -374,13 +406,18 @@ class Solver:
         grad_scale = sp.global_grad_scale if sp.global_grad_scale else 1.0
         iter_size = max(sp.iter_size, 1)
         grad_transform = self._grad_transform
+        guard = self._guard_on
+        spike = float(getattr(sp, "guard_loss_spike", 0.0) or 0.0)
+        ema_decay = float(getattr(sp, "guard_ema_decay", 0.9) or 0.9)
 
         def loss_fn(params, net_state, feeds, rng):
             blobs, new_state, loss = net.apply(params, net_state, feeds,
                                                train=True, rng=rng)
             return loss * grad_scale, (new_state, loss)
 
-        def step(params, net_state, opt_state, feeds_stack, it, rng):
+        def step(params, net_state, opt_state, feeds_stack, it, rng,
+                 gstate=None):
+            net_state0 = net_state
             # iter_size accumulation: feeds_stack pytree has leading
             # iter_size dim on every leaf (solver.cpp:277-288)
             def micro(carry, feeds_rng):
@@ -460,11 +497,114 @@ class Solver:
                         w2 = jax.lax.with_sharding_constraint(w2, repl)
                     new_params[lname][pname] = w2.astype(w.dtype)
                     new_opt[lname][pname] = slots2
-            return new_params, net_state, new_opt, loss_out, rate
+            if not guard:
+                return new_params, net_state, new_opt, loss_out, rate
+
+            # --- on-device skip-step guard (ISSUE 4) ---------------------
+            # Two load-bearing choices keep accepted steps BITWISE equal
+            # to guard-off on CPU:
+            # (1) the check reads the update's OUTPUTS (loss + new
+            #     params/momentum/BN state), not the gradients — any
+            #     non-finite gradient propagates into the updated state,
+            #     so the same class is detected (plus NaN entering
+            #     through BN statistics alone);
+            # (2) the entire guard — finiteness reductions, spike check,
+            #     selects, counter arithmetic — lives inside a
+            #     `lax.cond` BRANCH, i.e. a separate HLO computation.
+            #     XLA fusion cannot cross computation boundaries, so the
+            #     forward/backward/update graph keeps exactly the
+            #     consumers it has in guard-off mode (its values feed
+            #     the conditional's operand tuple, just as they would
+            #     feed the program root) and compiles to identical
+            #     arithmetic. In-graph selects/reductions consuming the
+            #     outputs directly get FUSED back into the update's
+            #     epilogues, re-tiling its reductions and perturbing
+            #     low-order bits (~1 ULP) — and
+            #     `lax.optimization_barrier` does NOT survive the CPU
+            #     pipeline to prevent it.
+            # The predicate is traced-but-always-true (`it` is never
+            # negative), so no simplification pass can fold the
+            # conditional away; the unreachable else-branch is the
+            # all-skip passthrough, which also keeps both branches
+            # structurally distinct.
+
+            def _apply_guard(op):
+                (loss_b, newp, newo, news, oldp, oldo, olds, gs,
+                 it_b) = op
+                ok = jnp.isfinite(loss_b)
+                for leaf in jax.tree.leaves((newp, newo, news)):
+                    if hasattr(leaf, "dtype") and jnp.issubdtype(
+                            leaf.dtype, jnp.floating):
+                        ok = jnp.logical_and(ok,
+                                             jnp.all(jnp.isfinite(leaf)))
+                if spike > 0:
+                    # EMA < 0 = "no accepted loss yet": never spikes. A
+                    # NaN loss compares False, so the finite check and
+                    # the spike check agree on non-finite steps.
+                    ok = jnp.logical_and(ok, jnp.where(
+                        gs["ema"] >= 0, loss_b <= spike * gs["ema"],
+                        True))
+                # scalar-predicate `where` passes the computed arrays
+                # through untouched on accept and keeps params/momentum/
+                # BN state at their inputs on skip. The iteration still
+                # advances — feeds and RNG stay aligned with the
+                # unguarded schedule.
+                keep = lambda n, o: jnp.where(ok, n, o)
+                ema = gs["ema"]
+                consec = jnp.where(ok, 0, gs["consec"] + 1).astype(
+                    jnp.int32)
+                new_gs = {
+                    "skips": gs["skips"] + jnp.where(ok, 0, 1).astype(
+                        jnp.int32),
+                    "consec": consec,
+                    # longest consecutive run EVER seen (monotone): a
+                    # >=M burst that recovers before the host looks
+                    # must still trip the divergence policy. Monotone
+                    # is safe because reaching M always exits — there
+                    # is no "after" in which a stale maximum could
+                    # re-trip — and it lets the host check lazily
+                    # (rate-limited at K=1) without missing bursts.
+                    "max_consec": jnp.maximum(gs["max_consec"], consec),
+                    "last_bad": jnp.where(ok, gs["last_bad"],
+                                          it_b).astype(jnp.int32),
+                    # the EMA absorbs ACCEPTED losses only: a diverging
+                    # tail cannot drag the spike baseline up after itself
+                    "ema": jnp.where(
+                        ok, jnp.where(ema >= 0,
+                                      ema_decay * ema
+                                      + (1.0 - ema_decay) * loss_b,
+                                      loss_b),
+                        ema).astype(jnp.float32),
+                }
+                return (jax.tree.map(keep, newp, oldp),
+                        jax.tree.map(keep, news, olds),
+                        jax.tree.map(keep, newo, oldo), new_gs)
+
+            def _all_skip(op):  # unreachable (it >= 0 always)
+                (_loss_b, _newp, _newo, _news, oldp, oldo, olds, gs,
+                 it_b) = op
+                return (oldp, olds, oldo, {
+                    "skips": gs["skips"] + 1,
+                    "consec": gs["consec"] + 1,
+                    "max_consec": jnp.maximum(gs["max_consec"],
+                                              gs["consec"] + 1),
+                    "last_bad": it_b,
+                    "ema": gs["ema"],
+                })
+
+            new_params, net_state, new_opt, new_gstate = jax.lax.cond(
+                it >= 0, _apply_guard, _all_skip,
+                (loss_out, new_params, new_opt, net_state,
+                 params, opt_state, net_state0, gstate, it))
+            return (new_params, net_state, new_opt, loss_out, rate,
+                    new_gstate)
 
         return step
 
     def _build_step(self):
+        # the guard carry (5 scalars) is NOT donated: the deferred
+        # divergence check reads the previous dispatch's gstate after
+        # the next one launches, so its buffer must stay valid
         return jax.jit(self._iteration_fn(), donate_argnums=(0, 1, 2))
 
     def _build_multi_step(self):
@@ -480,6 +620,27 @@ class Solver:
         arrays — the whole-loop-on-TPU strategy (arXiv:1810.09868) in
         place of the reference's overlap-by-threads (parallel.cpp)."""
         body = self._iteration_fn()
+
+        if self._guard_on:
+            # guard mode: the 5-scalar guard state rides in the scan
+            # carry exactly like params — zero extra dispatches, and the
+            # per-step skip decision never leaves HBM
+            def multi_g(params, net_state, opt_state, feeds_super, it0,
+                        base_rng, gstate):
+                def scan_body(carry, feeds_stack):
+                    p, s, o, it, gs = carry
+                    rng = jax.random.fold_in(base_rng, it + 1)
+                    p, s, o, loss, rate, gs = body(p, s, o, feeds_stack,
+                                                   it, rng, gs)
+                    return (p, s, o, it + 1, gs), (loss, rate)
+
+                ((params, net_state, opt_state, _, gstate),
+                 (losses, rates)) = jax.lax.scan(
+                    scan_body, (params, net_state, opt_state, it0, gstate),
+                    feeds_super)
+                return params, net_state, opt_state, losses, rates, gstate
+
+            return jax.jit(multi_g, donate_argnums=(0, 1, 2))
 
         def multi(params, net_state, opt_state, feeds_super, it0, base_rng):
             def scan_body(carry, feeds_stack):
@@ -565,10 +726,16 @@ class Solver:
         it0 = jnp.int32(self.iter)
         with self._guard("train dispatch"):
             FAULTS.maybe_stall("dispatch_stall")
-            (self.params, self.net_state, self.opt_state, losses,
-             rates) = self._multi_step_jit(self.params, self.net_state,
-                                           self.opt_state, feeds_super, it0,
-                                           self.base_rng)
+            if self._guard_on:
+                (self.params, self.net_state, self.opt_state, losses,
+                 rates, self._gstate) = self._multi_step_jit(
+                    self.params, self.net_state, self.opt_state,
+                    feeds_super, it0, self.base_rng, self._gstate)
+            else:
+                (self.params, self.net_state, self.opt_state, losses,
+                 rates) = self._multi_step_jit(
+                    self.params, self.net_state, self.opt_state,
+                    feeds_super, it0, self.base_rng)
         self.dispatch_count += 1
         return losses, rates
 
@@ -716,6 +883,77 @@ class Solver:
             f"watchdog:{label}", stalled_s=round(elapsed, 1),
             deadline_s=float(getattr(self.sp, "watchdog_deadline", 0.0)))
 
+    # ------------------------------------------------------------------
+    # Self-healing training (ISSUE 4): host side of the on-device guard.
+
+    # classic K=1 mode checks the guard counters every Nth dispatch
+    # (each check is a device_get = one tunnel RTT); fused chunks check
+    # every boundary. Detection latency is bounded by N iterations.
+    _GUARD_CHECK_EVERY = 16
+
+    def _fault_feed(self, feed_fn):
+        """Identity-cached FAULTS.wrap_feeds: one tuple check per
+        step() call when faults are off, and a stable wrapper identity
+        when they are on (the device feed queue re-keys on feed_fn).
+        Keyed on FAULTS.generation too, so reconfiguring the fault
+        plane between step() calls invalidates the cache instead of
+        silently returning the unwrapped (or stale-wrapped) fn."""
+        cached = self._fault_feed_cache
+        if cached is not None and cached[0] is feed_fn \
+                and cached[1] == FAULTS.generation:
+            return cached[2]
+        wrapped = FAULTS.wrap_feeds(feed_fn)
+        self._fault_feed_cache = (feed_fn, FAULTS.generation, wrapped)
+        return wrapped
+
+    def _guard_state0(self) -> dict:
+        """Fresh guard carry: no skips, no consecutive run, no bad
+        iteration seen, loss EMA unset (-1 sentinel)."""
+        gs = {"skips": jnp.int32(0), "consec": jnp.int32(0),
+              "max_consec": jnp.int32(0),
+              "last_bad": jnp.int32(-1), "ema": jnp.float32(-1.0)}
+        if self.mesh is not None:
+            gs = self.mesh.replicate(gs)
+        return gs
+
+    def _check_guard(self, boundary_iter: int, gstate) -> None:
+        """Materialize the guard counters of the dispatch that ended at
+        `boundary_iter` (a chunk-boundary host read — the only host
+        traffic the guard adds) and apply the divergence policy:
+        guard_max_skips consecutive skips journals the anomaly to
+        `<prefix>.run.json` and raises NumericAnomalyError, which the
+        CLI converts to exit code 88 for the supervisor to rewind."""
+        if gstate is None:
+            return
+        with self._guard("guard check"):
+            # host-sync: ok (chunk boundary, 5 scalars, one transfer)
+            vals = jax.device_get(gstate)
+        # max_consec = longest burst seen over the RUN (monotone in the
+        # carry; reset only by restore()): a >=M run that recovered
+        # before this check still trips the policy, even though
+        # `consec` reset on the accepted step that ended it. Monotone
+        # is sound because tripping exits the process — a caller that
+        # swallowed NumericAnomalyError and kept stepping would re-trip
+        # on every later check by design.
+        consec = max(int(vals["consec"]), int(vals["max_consec"]))
+        skips = int(vals["skips"])
+        last_bad = int(vals["last_bad"])
+        self.guard_sync_count += 1
+        if skips > self.skipped_steps and self.rank == 0:
+            log.warning(
+                "train guard: %d skipped step(s) so far (+%d this chunk, "
+                "last bad iteration %d, %d consecutive)", skips,
+                skips - self.skipped_steps, last_bad, consec)
+        self.skipped_steps = skips
+        m = int(getattr(self.sp, "guard_max_skips", 0) or 0)
+        if m > 0 and consec >= m:
+            self._journal_run_state(
+                "numeric_anomaly", consec_skips=consec,
+                skipped_steps=skips, last_bad_iter=last_bad,
+                exit_code=resilience.EXIT_NUMERIC)
+            raise resilience.NumericAnomalyError(
+                boundary_iter, consec, skips, last_bad)
+
     def _journal_run_state(self, reason: str, **extra) -> None:
         """Write the run manifest: the journal `--resume auto` and the
         operator read after a crash. Best-effort — journaling failures
@@ -739,6 +977,14 @@ class Solver:
         if self._step_jit is None:
             self._step_jit = self._build_step()
         self._ensure_watchdog()
+        # ISSUE 4 fault sites nan_grad/loss_spike poison feed batches;
+        # wrap_feeds returns feed_fn UNCHANGED when neither is
+        # configured, and the wrapper is cached so its identity is
+        # stable across step() calls (the device feed queue keys its
+        # worker on feed_fn identity)
+        feed_fn = self._fault_feed(feed_fn)
+        if self._guard_on and self._gstate is None:
+            self._gstate = self._guard_state0()
         sp = self.sp
         iter_size = max(sp.iter_size, 1)
         last_loss = float("nan")
@@ -799,10 +1045,16 @@ class Solver:
                     it = jnp.int32(self.iter)
                     with self._guard("train dispatch"):
                         FAULTS.maybe_stall("dispatch_stall")
-                        (self.params, self.net_state, self.opt_state, loss,
-                         rate) = self._step_jit(self.params, self.net_state,
-                                                self.opt_state, feeds_stack,
-                                                it, rng)
+                        if self._guard_on:
+                            (self.params, self.net_state, self.opt_state,
+                             loss, rate, self._gstate) = self._step_jit(
+                                self.params, self.net_state, self.opt_state,
+                                feeds_stack, it, rng, self._gstate)
+                        else:
+                            (self.params, self.net_state, self.opt_state,
+                             loss, rate) = self._step_jit(
+                                self.params, self.net_state, self.opt_state,
+                                feeds_stack, it, rng)
                     self.dispatch_count += 1
             # feed any in-flight eval pass the chunks whose super-batches
             # the worker finished while this train chunk dispatched —
@@ -840,6 +1092,26 @@ class Solver:
                          smoothed, float(rate))
             self.iter += c
             n -= c
+            if self._guard_on:
+                # deferred divergence check: materialize a PREVIOUS
+                # dispatch's guard counters now that this one is in
+                # flight — the read blocks on a program that has almost
+                # certainly retired, so the pipeline stays full. At
+                # K>1 every chunk boundary checks; at K=1 a per-
+                # iteration device_get would cost one tunnel RTT per
+                # iteration, so checks rate-limit to every
+                # _GUARD_CHECK_EVERY dispatches — safe, because the
+                # carried counters (skips, consec, monotone max_consec)
+                # lose nothing between checks; only detection latency
+                # is bounded by the interval
+                prev, self._guard_prev = (self._guard_prev,
+                                          (self.iter - 1, self._gstate))
+                self._guard_unchecked += 1
+                if prev is not None and (
+                        c > 1 or self._guard_unchecked
+                        >= self._GUARD_CHECK_EVERY):
+                    self._guard_unchecked = 0
+                    self._check_guard(*prev)
             if (sp.test_interval and test_feed_fns
                     and self.iter % sp.test_interval == 0
                     and (self.iter > 0 or sp.test_initialization)
@@ -851,9 +1123,26 @@ class Solver:
                 # will consume (it would pin HBM until close())
                 self._prefetch_test_feeds(test_feed_fns)
             if sp.snapshot and self.iter % sp.snapshot == 0:
+                if self._guard_on and self._guard_prev is not None:
+                    # the snapshot at this boundary becomes the rewind
+                    # target: the chunk that just ended must pass its
+                    # divergence check FIRST, or a >=M burst inside it
+                    # gets sealed into a verified snapshot that the
+                    # supervisor then rewinds to — skipping the
+                    # divergent region instead of replaying it
+                    # (iteration-exactness lost). The extra host read
+                    # is snapshot-rate, and snapshot() blocks on this
+                    # state moments later anyway.
+                    prev, self._guard_prev = self._guard_prev, None
+                    self._check_guard(*prev)
                 # interval snapshots don't stall the train loop (the
                 # reference's do: solver.cpp:339-344 writes inline)
                 self.snapshot(block=False)
+        if self._guard_on and self._guard_prev is not None:
+            # drain the deferred check so a divergence inside THIS call's
+            # final chunk surfaces before step() returns
+            prev, self._guard_prev = self._guard_prev, None
+            self._check_guard(*prev)
         # a pass dispatched at the final boundary must land before step()
         # returns (step's contract is "n iterations ran, events fired");
         # by now the eval programs sit ahead of the last train chunks in
@@ -1622,6 +1911,11 @@ class Solver:
                                            else None))
                 self.opt_state[lname][pname] = tuple(new)
         self._place_params_opt()
+        # ISSUE 4: a restored run starts with clean guard counters — a
+        # rewind exists to escape the divergence, not to instantly
+        # re-trip on the previous attempt's consecutive-skip count
+        self._gstate = None
+        self._guard_prev = None
         log.info("Restored solver state from %s (iter %d)", path, self.iter)
 
     def _load_snapshot_weights(self, model_path: str, state_path: str) -> None:

@@ -113,6 +113,35 @@ def _parser() -> argparse.ArgumentParser:
                    "ones after each write — never the newest verified "
                    "one (overrides solver snapshot_keep; 0 = prototxt "
                    "value, which defaults to keep-everything)")
+    # self-healing flags (ISSUE 4, docs/robustness.md)
+    p.add_argument("-train_guard", "--train-guard", dest="train_guard",
+                   action="store_true",
+                   help="arm the on-device non-finite guard: a NaN/Inf "
+                   "loss or gradient skips the optimizer update for "
+                   "that step (params/momentum/BN unchanged) instead "
+                   "of poisoning the weights; guard_max_skips "
+                   "consecutive skips journals the anomaly and exits "
+                   "88 for the supervisor to rewind (enables solver "
+                   "train_guard; off by default = bitwise today)")
+    p.add_argument("-guard_max_skips", "--guard-max-skips",
+                   dest="guard_max_skips", type=int, default=-1,
+                   help="consecutive skipped steps before exit 88; "
+                   "0 = never exit, skip forever (overrides solver "
+                   "guard_max_skips; -1 = prototxt value, which "
+                   "defaults to 3)")
+    p.add_argument("-anomaly_action", "--anomaly-action",
+                   dest="anomaly_action", default="",
+                   choices=["", "rewind", "rewind_lr", "abort"],
+                   help="supervisor policy on exit 88: rewind to the "
+                   "newest verified snapshot (default), rewind_lr = "
+                   "rewind with base_lr scaled by anomaly_lr_mult per "
+                   "numeric restart, abort = no restart (overrides "
+                   "solver anomaly_action)")
+    p.add_argument("-lr_scale", "--lr-scale", dest="lr_scale",
+                   type=float, default=1.0,
+                   help="multiply the solver's base_lr (set by the "
+                   "supervisor on rewind_lr restarts; compounded per "
+                   "numeric restart)")
     return p
 
 
@@ -231,7 +260,10 @@ def _supervised_train(args) -> int:
     env = dict(os.environ, CAFFE_SUPERVISED_CHILD="1")
     return resilience.supervise(
         base_cmd, resume_cmd, args.max_restarts,
-        failure_log=prefix + ".failures.log", env=env)
+        failure_log=prefix + ".failures.log", env=env,
+        anomaly_action=(args.anomaly_action or sp.anomaly_action
+                        or "rewind"),
+        anomaly_lr_mult=sp.anomaly_lr_mult)
 
 
 def cmd_train(args) -> int:
@@ -263,6 +295,17 @@ def cmd_train(args) -> int:
         sp.snapshot_keep = args.snapshot_keep
     if args.watchdog_deadline:
         sp.watchdog_deadline = args.watchdog_deadline
+    if args.train_guard:
+        sp.train_guard = True
+    if args.guard_max_skips >= 0:
+        # 0 is meaningful (never exit — skip forever); -1 = prototxt
+        sp.guard_max_skips = args.guard_max_skips
+    if args.lr_scale != 1.0:
+        # rewind_lr restart: the supervisor scales the recipe's LR so
+        # the replay does not step straight back into the divergence
+        sp.base_lr = sp.base_lr * args.lr_scale
+        log.info("base_lr scaled by %g -> %g (anomaly rewind)",
+                 args.lr_scale, sp.base_lr)
     model_dir = os.path.dirname(os.path.abspath(args.solver)) \
         if not (sp.net and os.path.exists(sp.net)) else ""
     gpipe_cfg = None
@@ -347,6 +390,13 @@ def cmd_train(args) -> int:
                 tf.append(f)
         test_feed_fns = tf
 
+    # bind the quarantine journal next to the snapshots: corrupt
+    # records the feeder substitutes during this run are audited in
+    # <prefix>.quarantine.json (ISSUE 4; appends across supervised
+    # restarts)
+    resilience.QUARANTINE.configure(
+        (sp.snapshot_prefix or "snapshot") + ".quarantine.json")
+
     t0 = time.time()
     start_iter = solver.iter
     try:
@@ -368,11 +418,21 @@ def cmd_train(args) -> int:
                 and solver.should_snapshot_after_train()):
             solver.snapshot()  # reference snapshots at stop/after-train
             # (solver.cpp:402-407)
+    except resilience.NumericAnomalyError as e:
+        # the solver already journaled the anomaly to <prefix>.run.json;
+        # exit 88 routes the supervisor through anomaly_action
+        # (rewind | rewind_lr | abort) instead of a plain crash restart
+        log.error("%s; exiting %d for the supervisor to rewind", e,
+                  resilience.EXIT_NUMERIC)
+        return resilience.EXIT_NUMERIC
     finally:
         # async interval writes must land even when training raises —
         # a half-written checkpoint is worse than a slow exit — and the
         # fused-mode feed queue's worker thread must not outlive the run
         solver.close()
+        # drain any debounced quarantine-journal tail: the audit must
+        # be complete on every exit path
+        resilience.QUARANTINE.flush()
     elapsed = time.time() - t0
     imgs = (solver.iter - start_iter) * solver._batch_images() \
         * max(sp.iter_size, 1) * max(solver._gpipe_micro, 1)

@@ -49,9 +49,51 @@ log = logging.getLogger("caffe_mpi_tpu.resilience")
 
 # distinct exit codes so the supervisor (and the operator's ps/log
 # archaeology) can tell a watchdog trip from an injected fault from an
-# ordinary crash
+# ordinary crash — and, since ISSUE 4, from a numeric divergence the
+# supervisor should REWIND (not merely restart) from
 EXIT_WATCHDOG = 86
 EXIT_FAULT = 87
+EXIT_NUMERIC = 88
+
+
+class NumericAnomalyError(RuntimeError):
+    """Training declared numeric divergence: `guard_max_skips`
+    consecutive steps were skipped by the on-device non-finite /
+    loss-spike guard. The solver journals the anomaly to
+    `<prefix>.run.json` before raising; the CLI converts this to exit
+    code EXIT_NUMERIC (88), which the supervisor maps through the
+    `anomaly_action` policy (rewind | rewind_lr | abort)."""
+
+    def __init__(self, it: int, consec: int, skipped: int, last_bad: int):
+        self.iter = it
+        self.consec = consec
+        self.skipped = skipped
+        self.last_bad = last_bad
+        super().__init__(
+            f"numeric divergence at iteration {it}: {consec} consecutive "
+            f"skipped step(s) ({skipped} total; last bad iteration "
+            f"{last_bad})")
+
+
+class RecordIntegrityError(RuntimeError):
+    """One dataset record failed integrity verification (crc32c
+    mismatch, structural DB corruption, or an undecodable Datum).
+    Deterministic — NOT retried like transient I/O; the feeder
+    quarantines the record instead."""
+
+    def __init__(self, source: str, index: int, reason: str):
+        self.source = source
+        self.index = index
+        self.reason = reason
+        super().__init__(
+            f"record {index} of {source or 'dataset'} failed integrity "
+            f"check: {reason}")
+
+
+class DataIntegrityError(RuntimeError):
+    """The quarantine ratio bound was exceeded: corruption is
+    systematic (dataset-level), not record-level — a hard, named
+    failure instead of silently training on substitutes."""
 
 _STATE_SUFFIXES = (".solverstate", ".solverstate.h5")
 _MANIFEST_SUFFIX = ".manifest.json"
@@ -61,6 +103,28 @@ _MANIFEST_SCHEMA = 1
 # ---------------------------------------------------------------------------
 # Fault-injection plane (test-only; env-keyed; zero cost when off)
 # ---------------------------------------------------------------------------
+
+# Every registered injection site, in one place: the docs
+# (docs/robustness.md) and the tier-1 doc-drift test
+# (tests/test_doc_drift.py) both read this, so a site added at a call
+# site without a registry entry — or documented without existing —
+# fails fast instead of rotting.
+FAULT_SITES = {
+    "feeder_read": "transient dataset read error (Feeder retry budget)",
+    "snapshot_kill": "hard-exit mid-snapshot-write (torn checkpoint)",
+    "snapshot_corrupt": "flip a byte of the model file post-manifest",
+    "snapshot_sync": "force interval snapshots to write blocking",
+    "dispatch_stall": "sleep inside a train dispatch (watchdog trip)",
+    "train_abort": "hard-exit at an iteration boundary (crash sim)",
+    "nan_grad": "poison float feeds with NaN for iterations "
+                "[arg, arg+count) — non-finite loss/gradients",
+    "loss_spike": "scale float feeds 1e3x for iterations "
+                  "[arg, arg+count) — finite loss explosion",
+    "record_corrupt": "flip a byte of record values [arg, arg+count) "
+                      "after fetch (bitrot the crc check must catch)",
+    "record_decode": "truncate record values [arg, arg+count) so the "
+                     "Datum parse fails",
+}
 
 class FaultPlane:
     """Injects failures at named sites, configured from the
@@ -87,6 +151,10 @@ class FaultPlane:
         self._sites: dict[str, dict] = {}
         self._dir = ""
         self._lock = threading.Lock()
+        # bumped on every (re)configure — consumers that cache derived
+        # state (the solver's wrapped feed_fn) key on it so a
+        # reconfiguration mid-run invalidates their cache
+        self.generation = 0
 
     def load_env(self) -> None:
         self.configure(os.environ.get("CAFFE_TPU_FAULTS", ""),
@@ -95,6 +163,7 @@ class FaultPlane:
     def configure(self, spec: str, once_dir: str = "") -> None:
         self._dir = once_dir
         self._sites = {}
+        self.generation += 1
         for entry in (spec or "").split(","):
             entry = entry.strip()
             if not entry:
@@ -153,6 +222,107 @@ class FaultPlane:
                     f.write(f"{time.time()}\n")
             except OSError:
                 pass
+
+    def active(self, site: str) -> bool:
+        """Is `site` configured (without consuming a firing)? The
+        zero-cost gate for wrappers that would otherwise add per-call
+        work even with faults off."""
+        return bool(self._sites) and site in self._sites
+
+    def fire_at(self, site: str, key: float, *,
+                durable_done: bool = True) -> str | None:
+        """Range-keyed firing: fires iff arg <= key < arg + count,
+        WITHOUT consuming the count. Unlike fire(), the decision is a
+        pure function of `key` (a record/iteration index), so it is
+        deterministic under prefetch-thread call reordering and under
+        rebuild-on-demand — the property the feed-poisoning and
+        record-corruption sites need for iteration-exact replay.
+        durable_done=False skips the cross-process done marker
+        (simulated bitrot must PERSIST across a supervised restart,
+        while a NaN burst must not re-fire after the rewind)."""
+        if not self._sites:
+            return None
+        with self._lock:
+            st = self._sites.get(site)
+            if st is None:
+                return None
+            try:
+                lo = float(st["arg"] or 0)
+            except ValueError:
+                return None
+            # count <= 0 keeps the plane-wide STICKY contract: every
+            # eligible key from `arg` onward (a finite count bounds the
+            # range instead of a consumable budget)
+            n = st["count"]
+            if key < lo or (n > 0 and key >= lo + n):
+                return None
+            if durable_done and not st.get("fired"):
+                st["fired"] = True
+                self._mark_done(site)
+            return st["arg"]
+
+    def wrap_feeds(self, feed_fn):
+        """Wrap a feed_fn with the `nan_grad` / `loss_spike` poisoning
+        sites (ISSUE 4): float leaves of the batch for micro-iterations
+        [arg, arg+count) are overwritten with NaN (nan_grad) or scaled
+        1e3x (loss_spike). Returns `feed_fn` UNCHANGED when neither
+        site is configured — the zero-cost-when-off contract (the
+        solver caches the wrapper, so identity matters: a fresh wrapper
+        per step() would churn the device feed queue)."""
+        if not (self.active("nan_grad") or self.active("loss_spike")):
+            return feed_fn
+        import numpy as np  # deferred: resilience imports at startup
+
+        def poison(feeds, fn):
+            out, hit = {}, False
+            for k, v in feeds.items():
+                # feeds here are host ndarrays from the batch builder
+                # (and this path only exists under fault injection)
+                arr = np.asarray(v)  # host-sync: ok
+                if np.issubdtype(arr.dtype, np.floating):
+                    arr = fn(arr.copy())
+                    hit = True
+                out[k] = arr
+            if not hit:
+                # uint8 device-transform staging has no float leaf to
+                # poison — silent no-op injection would make a test
+                # pass vacuously
+                log.warning("fault plane: batch has no float leaves to "
+                            "poison (device-transform staging? use "
+                            "transform_param { use_gpu_transform: "
+                            "false } in the test net)")
+            return out
+
+        def wrapped(it):
+            feeds = feed_fn(it)
+            if self.fire_at("nan_grad", it) is not None:
+                log.warning("fault plane: NaN-poisoning feeds for "
+                            "micro-iteration %d", it)
+                feeds = poison(feeds, lambda a: np.full_like(a, np.nan))
+            if self.fire_at("loss_spike", it) is not None:
+                log.warning("fault plane: 1e3x-scaling feeds for "
+                            "micro-iteration %d", it)
+                feeds = poison(feeds, lambda a: a * 1e3)
+            return feeds
+
+        return wrapped
+
+    def corrupt_bytes(self, site: str, raw: bytes, key: float) -> bytes:
+        """Record-level injection on FETCHED bytes (the mmap itself is
+        read-only): `record_corrupt` flips one mid-record byte,
+        `record_decode` truncates the record. Keyed by record index and
+        durable across restarts (real bitrot does not heal on resume),
+        so quarantine decisions replay identically."""
+        if not self._sites:
+            return raw
+        if self.fire_at(site, key, durable_done=False) is not None:
+            if site == "record_decode":
+                return raw[:max(len(raw) // 2, 1)]
+            b = bytearray(raw)
+            if b:
+                b[len(b) // 2] ^= 0xFF
+            return bytes(b)
+        return raw
 
     # -- one-line call-site helpers ------------------------------------
     def maybe_raise(self, site: str, exc_type=OSError, msg: str = "",
@@ -445,6 +615,141 @@ def read_run_manifest(prefix: str) -> dict | None:
 
 
 # ---------------------------------------------------------------------------
+# Quarantine journal — the data-integrity plane's audit artifact
+# ---------------------------------------------------------------------------
+
+class QuarantineLog:
+    """Journals quarantined dataset records to `<prefix>.quarantine.json`
+    (ISSUE 4). The feeder substitutes a corrupt record deterministically
+    (a pure function of the record index), so the journal is an AUDIT
+    record, not state resume depends on — but the operator reads it to
+    learn WHICH records are rotting, and the replay-determinism test
+    asserts two runs produce identical entries.
+
+    Writes are quarantine-rate (one atomic rewrite per newly-bad
+    record), never per-iteration. Unconfigured (no path), entries
+    accumulate in memory and only log — unit tests and library callers
+    pay no filesystem cost."""
+
+    def __init__(self):
+        self.path: str | None = None
+        self.entries: list[dict] = []
+        self._seen: set[tuple] = set()       # journal dedup (incl. preload)
+        self._warned: set[tuple] = set()     # THIS process's warnings
+        self._lock = threading.Lock()
+        self._last_flush = 0.0
+        self._dirty = False
+
+    def configure(self, path: str | None) -> None:
+        """Bind the journal file (the CLI passes
+        `<snapshot_prefix>.quarantine.json`). Existing entries from a
+        previous attempt are loaded so a supervised restart appends to
+        one continuous record instead of clobbering it."""
+        with self._lock:
+            self.path = path
+            self.entries = []
+            self._seen = set()
+            self._warned = set()
+            if not path:
+                return
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+                self.entries = list(doc.get("records", []))
+                self._seen = {(e.get("source"), e.get("index"))
+                              for e in self.entries}
+            except (OSError, ValueError):
+                pass
+
+    def record(self, source: str, index: int, substitute: int,
+               reason: str, key: str = "") -> None:
+        with self._lock:
+            if (source, index) in self._seen:
+                # already journaled (this process or a previous
+                # attempt's preload). A probe-casualty placeholder
+                # (substitute -1, "skipped during probing") upgrades in
+                # place when the record is later substituted as a
+                # primary — the audit must reflect the decision
+                # actually replayed every epoch.
+                upgraded = False
+                if substitute >= 0:
+                    for ent in self.entries:
+                        if (ent.get("source"), ent.get("index")) == \
+                                (source, index) \
+                                and ent.get("substitute", -1) < 0:
+                            ent["substitute"] = int(substitute)
+                            ent["reason"] = reason
+                            upgraded = True
+                            break
+                # the OPERATOR of this process must still hear about it
+                # once, or corruption that persists across a dataset
+                # "fix" goes silent
+                if (source, index) not in self._warned:
+                    self._warned.add((source, index))
+                    log.warning(
+                        "quarantined record %d of %s (-> substitute %d; "
+                        "already journaled by a previous attempt): %s",
+                        index, source or "dataset", substitute, reason)
+                if upgraded:
+                    self._flush_locked()
+                return
+            self._seen.add((source, index))
+            self._warned.add((source, index))
+            self.entries.append({
+                "source": source, "index": int(index), "key": key,
+                "substitute": int(substitute), "reason": reason,
+                "time": time.time()})
+            log.warning("quarantined record %d of %s (-> substitute %d): "
+                        "%s", index, source or "dataset", substitute,
+                        reason)
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        """Rewrite the journal (caller holds the lock). Debounced past
+        64 entries — one atomic rewrite per second instead of per
+        record — so mass corruption near the 5% quarantine bound costs
+        O(n) I/O, not O(n^2); the journal is a best-effort audit (the
+        substitution itself is replay-deterministic), so a crash losing
+        the last debounce window is acceptable."""
+        if not self.path:
+            return
+        self._dirty = True
+        now = time.monotonic()
+        if len(self.entries) > 64 and now - self._last_flush < 1.0:
+            return  # debounced; flush() drains the tail at shutdown
+        self._last_flush = now
+        self._dirty = False
+        doc = {"schema": _MANIFEST_SCHEMA, "records": self.entries}
+        try:
+            # the first quarantine can precede the first snapshot —
+            # the prefix directory may not exist yet
+            os.makedirs(os.path.dirname(self.path) or ".",
+                        exist_ok=True)
+            with atomic_output(self.path) as tmp:
+                with open(tmp, "w") as f:
+                    json.dump(doc, f, indent=1, sort_keys=True)
+        except OSError:
+            log.exception("quarantine journal write failed "
+                          "(continuing)")
+
+    def flush(self) -> None:
+        """Drain any debounced tail — call at clean shutdown (the CLI's
+        train teardown does) so the audit is complete even when the
+        last quarantines landed inside the debounce window."""
+        with self._lock:
+            if self._dirty:
+                self._last_flush = 0.0  # force the write
+                self._flush_locked()
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self.entries)
+
+
+QUARANTINE = QuarantineLog()
+
+
+# ---------------------------------------------------------------------------
 # Dispatch watchdog
 # ---------------------------------------------------------------------------
 
@@ -563,7 +868,9 @@ def supervise(first_cmd: list[str], resume_cmd: list[str],
               max_restarts: int, *, failure_log: str,
               env: dict | None = None, cwd: str | None = None,
               deadline: float | None = None,
-              backoff_base: float = 1.0, backoff_cap: float = 60.0) -> int:
+              backoff_base: float = 1.0, backoff_cap: float = 60.0,
+              anomaly_action: str = "rewind",
+              anomaly_lr_mult: float = 0.1) -> int:
     """Run a training child to completion, restarting on failure.
 
     Attempt 0 runs `first_cmd`; every restart runs `resume_cmd` (which
@@ -573,12 +880,24 @@ def supervise(first_cmd: list[str], resume_cmd: list[str],
     supervisor kill can't orphan a chip-claiming child. After
     `max_restarts` failed restarts the crash-loop guard gives up with
     the per-attempt record preserved in `failure_log`. Returns the last
-    child's exit code (0 on success, None->1 on deadline kill)."""
+    child's exit code (0 on success, None->1 on deadline kill).
+
+    Exit code EXIT_NUMERIC (88, ISSUE 4) — the child's on-device guard
+    declared numeric divergence — routes through `anomaly_action`:
+    `rewind` restarts from the newest verified snapshot like any
+    failure; `rewind_lr` additionally appends `-lr_scale` with
+    anomaly_lr_mult compounded per numeric restart, so the replay does
+    not step straight back into the divergence; `abort` treats the
+    divergence as fatal and returns 88 without restarting."""
     from .subproc import run_contained
     os.makedirs(os.path.dirname(failure_log) or ".", exist_ok=True)
     rc = 1
+    numeric_restarts = 0
     for attempt in range(max_restarts + 1):
-        cmd = first_cmd if attempt == 0 else resume_cmd
+        cmd = first_cmd if attempt == 0 else list(resume_cmd)
+        if attempt > 0 and numeric_restarts and anomaly_action == "rewind_lr":
+            cmd = cmd + ["-lr_scale",
+                         repr(anomaly_lr_mult ** numeric_restarts)]
         log.info("supervisor: attempt %d/%d: %s", attempt + 1,
                  max_restarts + 1, " ".join(cmd))
         t0 = time.time()
@@ -591,7 +910,9 @@ def supervise(first_cmd: list[str], resume_cmd: list[str],
                          attempt)
             return 0
         reason = ("deadline" if rc is None else
-                  "watchdog" if rc == EXIT_WATCHDOG else f"exit {rc}")
+                  "watchdog" if rc == EXIT_WATCHDOG else
+                  "numeric divergence" if rc == EXIT_NUMERIC else
+                  f"exit {rc}")
         with open(failure_log, "a") as f:
             f.write(f"[{time.ctime()}] attempt {attempt + 1}: {reason} "
                     f"after {dt:.1f}s: {' '.join(cmd)}\n")
@@ -599,12 +920,21 @@ def supervise(first_cmd: list[str], resume_cmd: list[str],
                 + (err or "").strip().splitlines()[-20:]
             for line in tail:
                 f.write(f"    {line}\n")
+        if rc == EXIT_NUMERIC:
+            if anomaly_action == "abort":
+                log.error("supervisor: numeric divergence with "
+                          "anomaly_action 'abort'; not restarting "
+                          "(log: %s)", failure_log)
+                return EXIT_NUMERIC
+            numeric_restarts += 1
         if attempt >= max_restarts:
             log.error("supervisor: crash-loop guard: %d failure(s); "
                       "giving up (log: %s)", attempt + 1, failure_log)
             break
         delay = min(backoff_base * (2 ** attempt), backoff_cap)
-        log.warning("supervisor: child failed (%s); restarting from the "
-                    "newest verified snapshot in %.1fs", reason, delay)
+        verb = ("rewinding to" if rc == EXIT_NUMERIC
+                else "restarting from")
+        log.warning("supervisor: child failed (%s); %s the newest "
+                    "verified snapshot in %.1fs", reason, verb, delay)
         time.sleep(delay)
     return 1 if rc is None else rc

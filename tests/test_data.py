@@ -345,21 +345,20 @@ class TestLevelDB:
         b = L._BlockBuilder()
         b.add(b"a" + _s.pack("<Q", (100 << 8) | 1), b"new")
         b.add(b"c" + _s.pack("<Q", (101 << 8) | TYPE_DELETION), b"")
-        import zlib
-        blk = b.finish()
-        off = len(table)
-        table += blk + bytes([0]) + _s.pack("<I", zlib.crc32(blk) & 0xFFFFFFFF)
-        h = L._put_uvarint(off) + L._put_uvarint(len(blk))
-        mi = L._BlockBuilder().finish()
-        mi_off = len(table)
-        table += mi + bytes([0]) + _s.pack("<I", 0)
-        mih = L._put_uvarint(mi_off) + L._put_uvarint(len(mi))
+        # real masked crc32c trailers: the reader verifies every block
+        # on read since ISSUE 4 (a real leveldb writer always stores
+        # them; the old zeros here only passed because nothing checked)
+        def emit(blk):
+            off = len(table)
+            table.extend(blk + bytes([0]) + _s.pack(
+                "<I", L.masked_crc32c(blk + bytes([0]))))
+            return L._put_uvarint(off) + L._put_uvarint(len(blk))
+
+        h = emit(b.finish())
+        mih = emit(L._BlockBuilder().finish())
         ib = L._BlockBuilder()
         ib.add(b.last_key, h)
-        ibb = ib.finish()
-        ib_off = len(table)
-        table += ibb + bytes([0]) + _s.pack("<I", 0)
-        ibh = L._put_uvarint(ib_off) + L._put_uvarint(len(ibb))
+        ibh = emit(ib.finish())
         footer = mih + ibh
         footer += b"\x00" * (40 - len(footer)) + _s.pack("<Q", L.TABLE_MAGIC)
         table += footer

@@ -1,0 +1,97 @@
+"""Doc-drift tripwires (ISSUE 4 satellite): the fault-injection site
+list is load-bearing operator documentation — a site added at a call
+site but missing from docs/robustness.md (or documented but deleted
+from the code) silently rots the runbook. Three sources of truth are
+held equal:
+
+  1. the registry: `utils/resilience.FAULT_SITES`
+  2. the docs:     the `Sites:` list in docs/robustness.md
+  3. the code:     literal site names at FAULTS call sites
+
+Pure text/AST checks — no jax, no device work; tier-1 cheap.
+"""
+
+import os
+import re
+
+from caffe_mpi_tpu.utils.resilience import FAULT_SITES
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# every FaultPlane entry point a production call site can name a site
+# through (fire/fire_at and the one-line helpers)
+_HELPERS = ("fire", "fire_at", "active", "maybe_raise", "maybe_stall",
+            "maybe_exit", "corrupt_file", "corrupt_bytes")
+_CALL_RE = re.compile(
+    r"\.(?:%s)\(\s*[\"']([a-z_]+)[\"']" % "|".join(_HELPERS))
+
+# source trees whose FAULTS call sites are production injection points
+# (tests configure sites by string; they are consumers, not sites)
+_SCAN = ("caffe_mpi_tpu", "tools", "bench.py")
+
+
+def _doc_sites() -> set[str]:
+    with open(os.path.join(_ROOT, "docs", "robustness.md")) as f:
+        text = f.read()
+    m = re.search(r"Sites:\s*(.*?)\.\s", text, re.DOTALL)
+    assert m, "docs/robustness.md lost its 'Sites:' list"
+    return set(re.findall(r"`([a-z_]+)`", m.group(1)))
+
+
+def _code_sites() -> set[str]:
+    sites: set[str] = set()
+    for target in _SCAN:
+        path = os.path.join(_ROOT, target)
+        if os.path.isfile(path):
+            files = [path]
+        else:
+            files = [os.path.join(r, n) for r, _d, ns in os.walk(path)
+                     for n in ns if n.endswith(".py")
+                     and "__pycache__" not in r]
+        for fp in files:
+            with open(fp) as f:
+                sites.update(_CALL_RE.findall(f.read()))
+    return sites
+
+
+class TestFaultSiteDrift:
+    def test_docs_match_registry(self):
+        assert _doc_sites() == set(FAULT_SITES), (
+            "docs/robustness.md 'Sites:' list and "
+            "resilience.FAULT_SITES disagree")
+
+    def test_call_sites_match_registry(self):
+        code = _code_sites()
+        undocumented = code - set(FAULT_SITES)
+        assert not undocumented, (
+            f"FAULTS call sites not in FAULT_SITES: {sorted(undocumented)}"
+            " — register them (and document in docs/robustness.md)")
+        dead = set(FAULT_SITES) - code
+        assert not dead, (
+            f"FAULT_SITES entries with no call site: {sorted(dead)}"
+            " — delete them (and from docs/robustness.md)")
+
+    def test_registry_entries_described(self):
+        for site, desc in FAULT_SITES.items():
+            assert isinstance(desc, str) and desc, site
+
+
+class TestLintCoverage:
+    def test_guard_and_quarantine_paths_are_linted(self):
+        """check_host_syncs.py must keep the ISSUE-4 hot paths in its
+        default target list (the lint is tier-1 via
+        tests/test_host_sync_lint.py — dropping a target silently
+        un-guards it)."""
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "check_host_syncs",
+            os.path.join(_ROOT, "tools", "check_host_syncs.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        targets = set(mod.DEFAULT_TARGETS)
+        for needed in ("caffe_mpi_tpu/data/feeder.py",
+                       "caffe_mpi_tpu/data/datasets.py",
+                       "caffe_mpi_tpu/data/lmdb_io.py",
+                       "caffe_mpi_tpu/data/leveldb_io.py",
+                       "caffe_mpi_tpu/utils/resilience.py"):
+            assert needed in targets, needed

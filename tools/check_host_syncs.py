@@ -46,9 +46,15 @@ _METHOD_CALLS = {"item"}                     # x.item()
 # feeder + resilience joined the targets with ISSUE 3: the feed queue's
 # retry loops and the watchdog/supervisor sit on the same dispatch hot
 # path as the solver, and a stray materialization there serializes the
-# pipeline just the same
+# pipeline just the same. ISSUE 4 added the guard/quarantine paths:
+# datasets + the LMDB/LevelDB cursors now run crc verification inside
+# the per-record hot loop, where an accidental device materialization
+# (or a future "let me just asarray this") would be paid per record.
 DEFAULT_TARGETS = ("caffe_mpi_tpu/solver", "caffe_mpi_tpu/parallel",
                    "caffe_mpi_tpu/data/feeder.py",
+                   "caffe_mpi_tpu/data/datasets.py",
+                   "caffe_mpi_tpu/data/lmdb_io.py",
+                   "caffe_mpi_tpu/data/leveldb_io.py",
                    "caffe_mpi_tpu/utils/resilience.py")
 
 # comprehensions/genexprs ARE loops: `[float(l) for l in losses]` pays

@@ -102,6 +102,12 @@ def main() -> int:
                    help="'auto' = resume from the newest verified "
                    "snapshot in the workdir (set by the supervisor on "
                    "restart)")
+    # self-healing knobs (ISSUE 4, docs/robustness.md)
+    p.add_argument("--train-guard", type=int, default=1,
+                   help="1 (default): run with the on-device non-finite "
+                   "guard armed, reporting skipped_steps + guard_syncs "
+                   "so the guard's ~zero overhead is measured on the "
+                   "real pipeline; 0 = unguarded")
     args = p.parse_args()
 
     if args.max_restarts > 0 \
@@ -128,9 +134,12 @@ def main() -> int:
                                      for a in argv) else [])
         env = dict(os.environ, CAFFE_SUPERVISED_CHILD="1")
         prefix = os.path.join(args.workdir, "e2e_snap", "s")
+        # exit 88 from the guarded child routes through the default
+        # rewind policy (the child converts NumericAnomalyError below)
         return resilience.supervise(
             base, resume, args.max_restarts,
-            failure_log=prefix + ".failures.log", env=env)
+            failure_log=prefix + ".failures.log", env=env,
+            anomaly_action="rewind")
 
     os.makedirs(args.workdir, exist_ok=True)
     db, mean = build_db(args.workdir, args.records)
@@ -182,6 +191,13 @@ def main() -> int:
         sp.snapshot = snap_every
     sp.snapshot_keep = max(args.snapshot_keep, 0)
     sp.watchdog_deadline = max(args.watchdog_deadline, 0.0)
+    # self-healing (ISSUE 4): non-finite guard in the fused scan; a
+    # corrupt LMDB record would quarantine via the crc sidecar the
+    # build_db writer published (journal next to the snapshots)
+    sp.train_guard = bool(args.train_guard)
+    from caffe_mpi_tpu.utils import resilience
+    resilience.QUARANTINE.configure(sp.snapshot_prefix
+                                    + ".quarantine.json")
 
     solver = Solver(sp)
     if args.resume == "auto":
@@ -197,12 +213,13 @@ def main() -> int:
         warmup = max(3, sp.step_chunk if sp.step_chunk > 1 else 0)
         solver.step(warmup, feeder)
         jax.block_until_ready(solver.params)
-        d0 = solver.dispatch_count
+        d0, g0 = solver.dispatch_count, solver.guard_sync_count
         t0 = time.perf_counter()
         solver.step(args.iters, feeder)
         jax.block_until_ready(solver.params)
         dt = time.perf_counter() - t0
         dispatches = solver.dispatch_count - d0
+        guard_syncs = solver.guard_sync_count - g0
 
         # untimed fused-eval phase: boundaries fire during 6 more train
         # iters; the eval scan runs between train chunks and the stall
@@ -222,6 +239,13 @@ def main() -> int:
                 f"test_dispatches_per_pass, "
                 f"{(solver.eval_stall_ms - ts0) / passes:.1f} "
                 f"eval_stall_ms")
+    except resilience.NumericAnomalyError as e:
+        # mirror cli.cmd_train: exit 88 so the supervisor above (or
+        # tpu_validation's harness) applies the rewind policy instead
+        # of treating the divergence as a generic crash
+        print(f"e2e-lmdb-train: {e}; exiting {resilience.EXIT_NUMERIC}",
+              file=sys.stderr)
+        return resilience.EXIT_NUMERIC
     finally:
         # failure paths must not leave prefetch workers holding the DB
         # (this runs inside tpu_validation's watched subprocess)
@@ -234,13 +258,18 @@ def main() -> int:
     peak = peak_flops(device)
     flops = train_flops_per_image(solver.net) * img_s
     mfu = f"{flops / peak:.1%}" if peak else "n/a"
+    guard_line = ""
+    if sp.train_guard:
+        guard_line = (f", guard: {solver.skipped_steps} skipped_steps, "
+                      f"{guard_syncs} guard_syncs")
     print(f"e2e-lmdb-train: {img_s:.1f} img/s (b{args.batch}, "
           f"{args.iters} iters, {device.device_kind}, MFU {mfu}, "
           f"step_chunk {sp.step_chunk}: {dispatches} dispatches for "
-          f"{args.iters} iters{eval_line}) — full host pipeline: LMDB "
-          "read -> decode -> transform/staging -> device super-batch "
-          "(prefetched in a worker thread) -> fused K-step scan; eval "
-          "passes fused+async (ISSUE 2)")
+          f"{args.iters} iters{eval_line}{guard_line}) — full host "
+          "pipeline: LMDB read -> crc verify -> decode -> "
+          "transform/staging -> device super-batch (prefetched in a "
+          "worker thread) -> fused K-step scan with non-finite guard; "
+          "eval passes fused+async (ISSUE 2)")
     return 0
 
 

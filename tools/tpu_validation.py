@@ -126,10 +126,15 @@ for causal in (False, True):
                  "-model", "models/alexnet/train_val.prototxt",
                  "-phase", "TEST", "-iterations", "10"],
                 600, log)
+            # snapshot under /tmp: the solver prototxt's relative
+            # prefix ("lenet") would litter lenet_iter_*.caffemodel +
+            # lenet.run.json into the repo root (they were once
+            # committed by accident — ISSUE 4 satellite)
             run("train-gpu-all",
                 [py, "-m", "caffe_mpi_tpu.tools.cli", "train",
                  "-solver", "models/lenet/lenet_solver.prototxt",
-                 "-synthetic", "-max_iter", "200", "-gpu", "all"],
+                 "-synthetic", "-max_iter", "200", "-gpu", "all",
+                 "-snapshot_prefix", "/tmp/caffe_tpu_val/lenet"],
                 600, log)
             # survivable training on real hardware (ISSUE 3): the fault
             # plane kills the child at iter 60; the supervisor must

@@ -53,6 +53,7 @@ def resize_image(im: np.ndarray, new_dims, interp_order: int = 1) -> np.ndarray:
     chans = []
     for c in range(im.shape[2]):
         chan = Image.fromarray(im[:, :, c].astype(np.float32), mode="F")
+        # lint: ok(host-sync) — PIL image channel, host data end to end
         chans.append(np.asarray(chan.resize((w, h), mode)))
     return np.stack(chans, axis=2)
 

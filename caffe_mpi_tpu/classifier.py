@@ -94,6 +94,7 @@ class Detector(_PreprocessingNet):
         """Apply context padding in window coordinates (reference
         detector.py detect_windows context_pad path / window_data_layer
         context_scale)."""
+        # lint: ok(host-sync) — window coords are host floats from the list
         y0, x0, y1, x1 = [float(v) for v in window]
         if self.context_pad:
             crop_h = float(crop_dims[0])

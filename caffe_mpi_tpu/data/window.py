@@ -60,7 +60,8 @@ class WindowFile:
             for j in range(num):
                 parts = lines[i + 4 + j].split()
                 cls = int(parts[0])
-                overlap = float(parts[1])
+                overlap = float(parts[1])  # lint: ok(host-sync) — text field
+                # lint: ok(host-sync) — window-file text fields, host strings
                 x1, y1, x2, y2 = (int(float(v)) for v in parts[2:6])
                 self._records.append((img_id, cls, overlap, x1, y1, x2, y2))
             i += 4 + num

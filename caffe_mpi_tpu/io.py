@@ -247,6 +247,7 @@ def save_caffemodel_h5(path: str, weights: dict[str, list[np.ndarray]]) -> None:
         for lname, blobs in weights.items():
             g = data.create_group(lname)
             for i, blob in enumerate(blobs):
+                # lint: ok(host-sync) — snapshot boundary, one pull per blob
                 g.create_dataset(str(i), data=np.asarray(blob, np.float32))
 
 
@@ -265,6 +266,7 @@ def load_caffemodel_h5(path: str) -> dict[str, list[np.ndarray]]:
             keys = list(group.keys())
             if keys and all(isinstance(group[k], h5py.Dataset)
                             for k in keys):
+                # lint: ok(host-sync) — h5py datasets, host data on load
                 out[prefix] = [np.asarray(group[str(i)])
                                for i in range(len(keys))]
                 return
@@ -302,6 +304,7 @@ def encode_solverstate(it: int, learned_net: str,
         nm = learned_net.encode("utf-8")
         out += _tag(2, 2) + _varint(len(nm)) + nm
     for blob in history:
+        # lint: ok(host-sync) — snapshot boundary, one pull per history blob
         b = encode_blob(np.asarray(blob))
         out += _tag(3, 2) + _varint(len(b)) + b
     if current_step:
@@ -346,6 +349,7 @@ def save_solverstate_h5(path: str, it: int, learned_net: str,
         f.create_dataset("current_step", data=np.int32(current_step))
         g = f.create_group("history")
         for i, blob in enumerate(history):
+            # lint: ok(host-sync) — snapshot boundary, one pull per blob
             g.create_dataset(str(i), data=np.asarray(blob, np.float32))
 
 
@@ -358,5 +362,6 @@ def load_solverstate_h5(path: str) -> tuple[int, str, list[np.ndarray], int]:
         current_step = int(np.asarray(f["current_step"])) \
             if "current_step" in f else 0
         g = f["history"]
+        # lint: ok(host-sync) — h5py datasets, host data on load
         history = [np.asarray(g[str(i)]) for i in range(len(g.keys()))]
     return it, learned_net, history, current_step

@@ -1,4 +1,10 @@
-"""Layer registry — importing this package registers all built-in layers."""
+"""Layer registry — importing this package registers all built-in layers.
+
+Reference: src/caffe/layer_factory.cpp (the CreatorRegistry that maps
+LayerParameter.type strings to constructors, plus its engine-dispatch
+special cases). Here registration is an import side effect of each layer
+module's `@register` decorator — no REGISTER_LAYER_CLASS macros.
+"""
 
 from .base import LAYER_REGISTRY, Layer, ParamDecl, create_layer, register, registered_types
 from . import activations  # noqa: F401

@@ -56,7 +56,9 @@ class PythonLayer(Layer):
             jax.ShapeDtypeStruct(s, jnp.float32) for s in self.out_shapes)
 
         def host_forward(*arrays):
+            # lint: ok(host-sync) — pure_callback hands host ndarrays in
             outs = impl.forward([np.asarray(a) for a in arrays])
+            # lint: ok(host-sync) — normalizing the user layer's host output
             return tuple(np.asarray(o, np.float32) for o in outs)
 
         if hasattr(impl, "backward"):
@@ -74,9 +76,11 @@ class PythonLayer(Layer):
 
                 def host_backward(*args):
                     n_top = len(out_structs)
+                    # lint: ok(host-sync) — pure_callback hands host ndarrays
                     top_diffs = [np.asarray(a) for a in args[:n_top]]
-                    bots = [np.asarray(a) for a in args[n_top:]]
+                    bots = [np.asarray(a) for a in args[n_top:]]  # lint: ok(host-sync) — ditto
                     diffs = impl.backward(top_diffs, bots)
+                    # lint: ok(host-sync) — user layer's host output
                     return tuple(np.asarray(d, np.float32) for d in diffs)
 
                 in_structs = tuple(
@@ -146,6 +150,8 @@ class HDF5OutputLayer(Layer):
             g = f.create_group(f"batch_{self._batch_counter}")
             for i, arr in enumerate(arrays):
                 name = "data" if i == 0 else "label" if i == 1 else f"blob{i}"
+                # HDF5Output host callback: pure_callback already
+                # lint: ok(host-sync) — materialized the arrays on host
                 g.create_dataset(name, data=np.asarray(arr))
         self._initialized = True
         self._batch_counter += 1

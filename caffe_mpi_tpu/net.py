@@ -438,12 +438,15 @@ class Net:
             correction = 1.0
             for (kind, pname), blob in zip(spec, blobs):
                 if kind == "correction":
+                    # caffemodel blobs arrive as host ndarrays from the
+                    # lint: ok(host-sync) — parser; import is load-time
                     c = float(np.asarray(blob).reshape(-1)[0])
                     # BVLC stores mean/var pre-scaled by the correction;
                     # scale_factor = (c == 0 ? 0 : 1/c) — a zero correction
                     # zeroes the running stats (batch_norm_layer.cpp)
                     correction = 0.0 if c == 0.0 else (1.0 / c)
             for (kind, pname), blob in zip(spec, blobs):
+                # lint: ok(host-sync) — load-time weight import, host data
                 blob = np.asarray(blob, np.float32)
                 if kind == "param":
                     owner = self.param_aliases.get((layer.name, pname),

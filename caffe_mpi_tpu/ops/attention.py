@@ -1,3 +1,5 @@
+# lint: ok(reference-citation) — TPU-native op: the CNN-era reference has
+# no attention kernel to cite; SURVEY §5.7 records the design decision
 """Attention + ring attention (sequence/context parallelism).
 
 The reference is a CNN-era framework with no attention op (SURVEY §5.7),

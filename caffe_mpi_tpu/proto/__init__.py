@@ -1,4 +1,9 @@
-"""Config layer: prototxt text-format parsing + typed Caffe parameter schema."""
+"""Config layer: prototxt text-format parsing + typed Caffe parameter schema.
+
+Reference: src/caffe/proto/caffe.proto (compiled with protoc there;
+dataclasses coerced from the text-format tree here — see config.py and
+text_format.py for the per-message mapping).
+"""
 
 from .text_format import PbEnum, PbNode, PrototxtError, parse, parse_file
 from .config import (

@@ -174,8 +174,9 @@ def _migrate_v0_layer(lp: LayerParameter) -> None:
         _migrate_v0_data_fields(lp, v0, v0_type)
 
     # per-blob multipliers live on the V0 node (fields 51/52)
+    # lint: ok(host-sync) — prototxt text values, host strings
     lrs = [float(x) for x in v0.get_list("blobs_lr")]
-    wds = [float(x) for x in v0.get_list("weight_decay")]
+    wds = [float(x) for x in v0.get_list("weight_decay")]  # lint: ok(host-sync) — ditto
     for i in range(max(len(lrs), len(wds))):
         spec = ParamSpec()
         if i < len(lrs):
@@ -247,9 +248,10 @@ def _migrate_v1_blob_multipliers(lp: LayerParameter) -> None:
     for i in range(n):
         spec = ParamSpec()
         if i < len(lrs):
+            # lint: ok(host-sync) — prototxt text values, host strings
             spec.lr_mult = float(lrs[i])
         if i < len(wds):
-            spec.decay_mult = float(wds[i])
+            spec.decay_mult = float(wds[i])  # lint: ok(host-sync) — ditto
         lp.param.append(spec)
 
 

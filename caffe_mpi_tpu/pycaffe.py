@@ -178,6 +178,7 @@ class Net:
         import jax
         import jax.numpy as jnp
         for k, v in kwargs.items():
+            # lint: ok(host-sync) — user-supplied feed arrays, host data
             self._inputs[k] = np.asarray(v)
         feeds = {}
         for name in self._net.feed_blobs:
@@ -189,6 +190,8 @@ class Net:
             self._fwd_jit = jax.jit(
                 lambda p, s, f: self._net.apply(p, s, f, train=False)[0])
         env = self._fwd_jit(self._params, self._state, feeds)
+        # pycaffe API contract: net.forward() exposes every blob as numpy
+        # lint: ok(host-sync) — one harvest per forward, not per-iteration
         self._blob_values = {k: np.array(v) for k, v in env.items()}
         want = blobs or self.outputs
         return {b: self._blob_values[b] for b in want

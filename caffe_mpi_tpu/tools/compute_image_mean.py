@@ -32,6 +32,7 @@ def main(argv=None) -> int:
     n = len(ds)
     for i in range(n):
         img, _ = ds.get(i)
+        # lint: ok(host-sync) — DB records decode to host ndarrays
         img = np.asarray(img, np.float64)
         total = img if total is None else total + img
     mean = (total / n).astype(np.float32)

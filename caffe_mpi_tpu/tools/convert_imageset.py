@@ -29,7 +29,7 @@ def iter_datums(root: str, items, resize_hw, gray: bool):
         img = img.convert("L" if gray else "RGB")
         if resize_hw[0] and resize_hw[1]:
             img = img.resize((resize_hw[1], resize_hw[0]), Image.BILINEAR)
-        arr = np.asarray(img)
+        arr = np.asarray(img)  # lint: ok(host-sync) — PIL image, host data
         if arr.ndim == 2:
             arr = arr[None]
         else:

@@ -54,6 +54,8 @@ def main(argv=None) -> int:
         feeds = {k: jnp.asarray(v) for k, v in feeds.items()}
         env = fwd(params, state, feeds)
         for b in blob_names:
+            # feature dump IS the workload: one bounded pull per batch
+            # lint: ok(host-sync) — into the HDF5 output
             chunks[b].append(np.asarray(env[b]))
     with h5py.File(args.output, "w") as f:
         for b in blob_names:

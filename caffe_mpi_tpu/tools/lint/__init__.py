@@ -1,0 +1,383 @@
+"""tpulint — whole-tree static analysis for TPU-hostile code patterns.
+
+Replaces the compile-time safety net the reference stack gets for free
+(C++ types + nvcc reject most of its bug classes at build time,
+e.g. Makefile + src/caffe/CMakeLists.txt drive a type-checked build;
+tools/check_host_syncs.py was this framework's single-pass ancestor).
+In the JAX rebuild the costliest defects — a `float()` paying one
+tunnel RTT per loop iteration, a Python `if` on a traced value, a
+traced `lax.reduce_window` init breaking reverse-mode under the axon
+hook — compile fine and only surface on a live TPU, which is exactly
+the resource this environment cannot count on. So the checks run on
+the AST, before any dispatch, with no jax import: the suite survives a
+dead tunnel and costs nothing in tier-1.
+
+Framework shape:
+
+- every check is a `LintPass` subclass registered by `@register`; a
+  pass implements `check(ctx)` (per file) and/or `check_tree(ctxs,
+  root)` (cross-file, e.g. doc-drift)
+- findings are waived per statement with a `lint: ok(<pass>) — reason`
+  comment on any line of the statement's span or the line directly
+  above; the reason is part of the contract — the author claims, in
+  the diff, that the flagged pattern is deliberate
+- the legacy `# host-sync: ok` spelling keeps working as a waiver for
+  the host-sync pass (compat with pre-framework annotations)
+- a waiver naming an unknown pass is itself a finding (bad-waiver):
+  a misspelled waiver must fail the run, never silently suppress
+- CLI: `python -m caffe_mpi_tpu.tools.lint [--select P,...] [--json]
+  [paths...]`; default paths are the shipped tree (caffe_mpi_tpu/,
+  tools/, bench.py); exit 1 on any finding
+
+See docs/static_analysis.md for the pass catalog and how to add one.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import io
+import json
+import os
+import re
+import sys
+import tokenize
+from dataclasses import dataclass
+from typing import Iterable, Iterator
+
+# ---------------------------------------------------------------------------
+# findings + waivers
+
+_WAIVER_RE = re.compile(r"#\s*lint:\s*ok\(([^)]*)\)")
+_LEGACY_WAIVER_RE = re.compile(r"#\s*host-sync:\s*ok")
+
+
+def extract_waivers(src: str) -> dict[int, set[str]]:
+    """{line: waived pass names} from the REAL comment tokens of `src`.
+    Tokenizing (rather than regexing whole lines) keeps waiver grammar
+    quoted inside string literals or docstrings from registering as a
+    waiver — text that merely *mentions* the grammar must not suppress
+    a finding on its statement."""
+    waivers: dict[int, set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(src).readline)
+        comments = [(t.start[0], t.string) for t in tokens
+                    if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        comments = []        # unparseable files surface as 'syntax'
+    for ln, text in comments:
+        names: set[str] = set()
+        for m in _WAIVER_RE.finditer(text):
+            names.update(n.strip() for n in m.group(1).split(",")
+                         if n.strip())
+        if _LEGACY_WAIVER_RE.search(text):
+            names.add("host-sync")
+        if names:
+            waivers.setdefault(ln, set()).update(names)
+    return waivers
+
+
+@dataclass
+class Finding:
+    """One lint violation. `span` is the (first, last) 1-based line range
+    a waiver comment is honored on (None = unwaivable); `detail` is a
+    short machine tag (e.g. the flagged call shape) for compat shims."""
+    pass_name: str
+    path: str
+    line: int
+    message: str
+    span: tuple[int, int] | None = None
+    detail: str = ""
+
+    def format(self, root: str | None = None) -> str:
+        path = os.path.relpath(self.path, root) if root else self.path
+        return f"{path}:{self.line}: [{self.pass_name}] {self.message}"
+
+    def as_dict(self, root: str | None = None) -> dict:
+        path = os.path.relpath(self.path, root) if root else self.path
+        return {"pass": self.pass_name, "path": path, "line": self.line,
+                "message": self.message, "detail": self.detail}
+
+
+class FileContext:
+    """One parsed source file shared by all passes: source text, lines,
+    AST (None on syntax error), and the per-line waiver map."""
+
+    def __init__(self, path: str, root: str | None = None):
+        self.path = os.path.abspath(path)
+        self.root = root
+        with open(path, encoding="utf-8") as f:
+            self.src = f.read()
+        self.lines = self.src.splitlines()
+        self.tree: ast.Module | None = None
+        self.syntax_error: SyntaxError | None = None
+        try:
+            self.tree = ast.parse(self.src, filename=path)
+        except SyntaxError as e:
+            self.syntax_error = e
+        # line -> set of pass names waived on that line (comment
+        # tokens only — quoted grammar in strings does not count)
+        self.waivers: dict[int, set[str]] = extract_waivers(self.src)
+
+    @property
+    def rel(self) -> str:
+        """Path relative to the run root; absolute if outside it."""
+        if self.root:
+            r = os.path.relpath(self.path, self.root)
+            if not r.startswith(".."):
+                return r
+        return self.path
+
+    def span_of(self, stmt: ast.stmt | ast.expr) -> tuple[int, int]:
+        """Waiver-search span for a node: its own line range. `waived`
+        additionally honors a comment-ONLY line directly above. For a
+        compound statement (if/while/for/with/def) the span is the
+        HEADER only — a waiver on some nested body statement must not
+        silently suppress a finding anchored to the header."""
+        end = getattr(stmt, "end_lineno", None) or stmt.lineno
+        body = getattr(stmt, "body", None)
+        if isinstance(body, list) and body and isinstance(body[0],
+                                                          ast.stmt):
+            # header end = end of the test/iter expression (NOT
+            # body[0].lineno - 1: a comment line between header and
+            # body must not fall inside the header span)
+            hdr = stmt.lineno
+            for attr in ("test", "iter", "items"):
+                v = getattr(stmt, attr, None)
+                for n in (v if isinstance(v, list)
+                          else [v] if v is not None else []):
+                    hdr = max(hdr, getattr(n, "end_lineno", 0) or 0)
+            end = min(end, hdr)
+        return (stmt.lineno, end)
+
+    def comment_only(self, ln: int) -> bool:
+        text = self.lines[ln - 1] if 0 < ln <= len(self.lines) else ""
+        return text.lstrip().startswith("#")
+
+    def waived(self, span: tuple[int, int] | None, pass_name: str) -> bool:
+        """A waiver counts anywhere in the statement's span (trailing
+        comments included), or on the line directly above IF that line
+        is comment-only — a trailing waiver on the PREVIOUS statement
+        must not silently leak onto the next one."""
+        if span is None:
+            return False
+        lo, hi = span
+        if any(pass_name in self.waivers.get(ln, ())
+               for ln in range(lo, hi + 1)):
+            return True
+        above = lo - 1
+        return (above >= 1 and self.comment_only(above)
+                and pass_name in self.waivers.get(above, ()))
+
+
+# ---------------------------------------------------------------------------
+# pass registry
+
+class LintPass:
+    """Base class. Subclasses set `name` + `description` and override
+    `check` (per-file) and/or `check_tree` (whole-run, for cross-file
+    invariants). Yield `Finding`s; the framework applies waivers."""
+
+    name: str = ""
+    description: str = ""
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        return iter(())
+
+    def check_tree(self, ctxs: list[FileContext],
+                   root: str) -> Iterator[Finding]:
+        return iter(())
+
+
+REGISTRY: dict[str, LintPass] = {}
+
+
+def register(cls: type[LintPass]) -> type[LintPass]:
+    inst = cls()
+    assert inst.name and inst.name not in REGISTRY, inst.name
+    REGISTRY[inst.name] = inst
+    return cls
+
+
+def _load_passes() -> None:
+    # import for side effect: each module registers its pass(es)
+    from . import (concrete_init, doc_drift, gated_imports,  # noqa: F401
+                   host_sync, reference_citation, traced_flow)
+
+
+# ---------------------------------------------------------------------------
+# tree walking + running
+
+def repo_root() -> str:
+    """The directory holding the caffe_mpi_tpu package."""
+    pkg = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.dirname(pkg)
+
+
+DEFAULT_SCAN = ("caffe_mpi_tpu", "tools", "bench.py")
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
+    for target in paths:
+        if os.path.isdir(target):
+            for dirpath, dirnames, files in os.walk(target):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__")
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(dirpath, name)
+        elif target.endswith(".py"):
+            yield target
+
+
+def _bad_waiver_findings(ctx: FileContext,
+                         known: set[str]) -> Iterator[Finding]:
+    for ln, names in sorted(ctx.waivers.items()):
+        for name in sorted(names - known):
+            yield Finding(
+                "bad-waiver", ctx.path, ln,
+                f"waiver names unknown pass {name!r} (known: "
+                f"{', '.join(sorted(known))}) — a misspelled waiver "
+                "suppresses nothing", span=None)
+
+
+def run_lint(paths: Iterable[str] | None = None,
+             select: Iterable[str] | None = None,
+             root: str | None = None) -> list[Finding]:
+    """Run the selected passes (default: all) over `paths` (default:
+    the shipped tree under `root`). Returns waiver-filtered findings,
+    ordered by path then line."""
+    _load_passes()
+    root = root or repo_root()
+    if paths is None:
+        # default-scan entries are filtered by existence (a fixture
+        # root need not model bench.py); EXPLICIT paths must exist —
+        # a typo'd CI path silently reporting "clean" is the one
+        # failure mode a tripwire cannot afford
+        paths = [p for p in (os.path.join(root, t) for t in DEFAULT_SCAN)
+                 if os.path.exists(p)]
+    else:
+        paths = list(paths)
+        bad = [p for p in paths
+               if not os.path.exists(p)
+               or (os.path.isfile(p) and not p.endswith(".py"))]
+        if bad:
+            raise FileNotFoundError(
+                f"lint path(s) do not exist or are not .py: {bad}")
+    if select is None:
+        passes = list(REGISTRY.values())
+    else:
+        unknown = [s for s in select if s not in REGISTRY]
+        if unknown:
+            # ValueError, not KeyError: main() maps this to a usage
+            # error, and a broad KeyError catch would also swallow
+            # genuine pass bugs as exit 2
+            raise ValueError(
+                f"unknown pass(es) {unknown}; known: {sorted(REGISTRY)}")
+        passes = [REGISTRY[s] for s in select]
+    selected = {p.name for p in passes}
+
+    ctxs: list[FileContext] = []
+    findings: list[Finding] = []
+    for path in iter_py_files(paths):
+        ctx = FileContext(path, root=root)
+        if ctx.syntax_error is not None:
+            e = ctx.syntax_error
+            findings.append(Finding(
+                "syntax", ctx.path, e.lineno or 0,
+                f"SYNTAX ERROR: {e.msg}", span=None,
+                detail=f"SYNTAX ERROR: {e.msg}"))
+            continue
+        ctxs.append(ctx)
+        findings.extend(_bad_waiver_findings(ctx, set(REGISTRY)))
+        for p in passes:
+            findings.extend(f for f in p.check(ctx)
+                            if not ctx.waived(f.span, p.name))
+    for p in passes:
+        findings.extend(p.check_tree(ctxs, root))
+    # tree findings from files in ctxs honor waivers too
+    by_path = {c.path: c for c in ctxs}
+    findings = [f for f in findings
+                if not (f.pass_name in selected and f.path in by_path
+                        and by_path[f.path].waived(f.span, f.pass_name))]
+    findings.sort(key=lambda f: (f.path, f.line, f.pass_name))
+    return findings
+
+
+def run_pass_on_file(pass_name: str, path: str,
+                     root: str | None = None) -> list[Finding]:
+    """One pass over one file (compat-shim entry point). Syntax errors
+    come back as a single 'syntax' finding."""
+    _load_passes()
+    ctx = FileContext(path, root=root or repo_root())
+    if ctx.syntax_error is not None:
+        e = ctx.syntax_error
+        return [Finding("syntax", ctx.path, e.lineno or 0,
+                        f"SYNTAX ERROR: {e.msg}", span=None,
+                        detail=f"SYNTAX ERROR: {e.msg}")]
+    p = REGISTRY[pass_name]
+    return [f for f in p.check(ctx) if not ctx.waived(f.span, p.name)]
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers used by several passes
+
+def attr_root(node: ast.expr) -> str | None:
+    """Base name of a dotted chain: `lax.scan` -> 'lax',
+    `jax.lax.scan` -> 'jax'. None for anything not Name-rooted."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def dotted_name(node: ast.expr) -> str | None:
+    """Full dotted spelling of a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+def main(argv: list[str] | None = None) -> int:
+    _load_passes()
+    ap = argparse.ArgumentParser(
+        prog="python -m caffe_mpi_tpu.tools.lint",
+        description="tpulint — static analysis for TPU-hostile patterns")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to scan (default: shipped tree)")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated pass names (default: all)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as a JSON array")
+    ap.add_argument("--list", action="store_true", dest="list_passes",
+                    help="list registered passes and exit")
+    args = ap.parse_args(argv)
+    if args.list_passes:
+        for name in sorted(REGISTRY):
+            print(f"{name:22s} {REGISTRY[name].description}")
+        return 0
+    select = ([s.strip() for s in args.select.split(",") if s.strip()]
+              if args.select else None)
+    root = repo_root()
+    try:
+        findings = run_lint(args.paths or None, select=select, root=root)
+    except (ValueError, FileNotFoundError) as e:
+        print(e.args[0], file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps([f.as_dict(root) for f in findings], indent=1))
+    else:
+        for f in findings:
+            print(f.format(root))
+    if findings:
+        print(f"{len(findings)} lint finding(s)", file=sys.stderr)
+        return 1
+    return 0

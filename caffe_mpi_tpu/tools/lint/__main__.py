@@ -1,0 +1,11 @@
+"""CLI entry: `python -m caffe_mpi_tpu.tools.lint` (see package
+docstring; ancestor: tools/check_host_syncs.py, now a shim over this.
+The reference's analogue is the build system itself — Makefile + nvcc
+reject these bug classes at compile time)."""
+
+import sys
+
+from . import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,223 @@
+"""doc-drift pass — FAULT_SITES registry vs docs vs call sites.
+
+Folds tests/test_doc_drift.py's fault-injection consistency check into
+the lint CLI (the test is now a thin wrapper over this pass — one
+enforcement path, two entry points). The site list is load-bearing
+operator documentation (docs/robustness.md): a site added at a call
+site but missing from the registry silently rots the runbook, a
+registry entry whose call site was deleted documents a lever that no
+longer exists. Three sources of truth are held equal:
+
+  1. the registry: `FAULT_SITES` in caffe_mpi_tpu/utils/resilience.py
+     (read by AST, not import — the pass must run without the package
+     importable, e.g. from a checkout with a broken module)
+  2. the docs:     the `Sites:` list in docs/robustness.md
+  3. the code:     literal site names at FAULTS helper call sites
+     under caffe_mpi_tpu/, tools/ and bench.py
+
+Unlike the per-file passes this one always scans the tree rooted at
+the run root (`check_tree`), regardless of which paths were selected —
+a partial scan must not report half the call sites as dead. Roots
+without a registry/docs pair (plain projects, fixture dirs that don't
+model them) produce no findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterator
+
+from . import (DEFAULT_SCAN, Finding, LintPass, extract_waivers,
+               iter_py_files, register)
+
+# every FaultPlane entry point a production call site can name a site
+# through (fire/fire_at and the one-line helpers)
+_HELPERS = ("fire", "fire_at", "active", "maybe_raise", "maybe_stall",
+            "maybe_exit", "corrupt_file", "corrupt_bytes")
+_CALL_RE = re.compile(
+    r"\.(?:%s)\(\s*[\"']([a-z_]+)[\"']" % "|".join(_HELPERS))
+
+REGISTRY_FILE = os.path.join("caffe_mpi_tpu", "utils", "resilience.py")
+DOCS_FILE = os.path.join("docs", "robustness.md")
+# source trees whose FAULTS call sites are production injection points
+# (tests configure sites by string; they are consumers, not sites) —
+# the framework's default scan, so the two roots cannot drift apart
+SCAN = DEFAULT_SCAN
+
+
+def _registry_sites(path: str) -> tuple[dict[str, tuple[int, str]], int]:
+    """{site: (line, description)} from the FAULT_SITES dict literal,
+    plus the assignment's line (0 when absent)."""
+    tree = ast.parse(open(path, encoding="utf-8").read(), filename=path)
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+            value = node.value
+        else:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == "FAULT_SITES" and \
+                    isinstance(value, ast.Dict):
+                sites = {}
+                for k, v in zip(value.keys, value.values):
+                    if isinstance(k, ast.Constant) and isinstance(
+                            k.value, str):
+                        desc = (v.value if isinstance(v, ast.Constant)
+                                and isinstance(v.value, str) else "")
+                        sites[k.value] = (k.lineno, desc)
+                return sites, node.lineno
+    return {}, 0
+
+
+def _stmt_spans(tree: ast.Module | None) -> dict[int, tuple[int, int]]:
+    """{line: (start, end) of the innermost statement covering it} —
+    lets waivers honor the whole statement span for multi-line calls,
+    matching FileContext.span_of. Empty for unparseable files."""
+    spans: dict[int, tuple[int, int]] = {}
+    if tree is None:
+        return spans
+    for node in ast.walk(tree):  # BFS: inner statements overwrite
+        if isinstance(node, ast.stmt):
+            end = getattr(node, "end_lineno", node.lineno) or node.lineno
+            for ln in range(node.lineno, end + 1):
+                spans[ln] = (node.lineno, end)
+    return spans
+
+
+def _doc_sites(path: str) -> tuple[set[str], int]:
+    text = open(path, encoding="utf-8").read()
+    m = re.search(r"Sites:\s*(.*?)\.\s", text, re.DOTALL)
+    if not m:
+        return set(), 0
+    line = text[:m.start()].count("\n") + 1
+    return set(re.findall(r"`([a-z_]+)`", m.group(1))), line
+
+
+@register
+class DocDriftPass(LintPass):
+    name = "doc-drift"
+    description = ("FAULT_SITES registry == docs/robustness.md Sites "
+                   "list == FAULTS call sites")
+
+    def check_tree(self, ctxs: list[FileContext],
+                   root: str) -> Iterator[Finding]:
+        reg_path = os.path.join(root, REGISTRY_FILE)
+        docs_path = os.path.join(root, DOCS_FILE)
+        if not (os.path.isfile(reg_path) and os.path.isfile(docs_path)):
+            return
+        registry, reg_line = _registry_sites(reg_path)
+        if not reg_line:
+            return
+        reg_src = open(reg_path, encoding="utf-8").read()
+        reg_waivers = extract_waivers(reg_src)
+        reg_lines = reg_src.splitlines()
+
+        def reg_waived(ln: int) -> bool:
+            """Waiver on the registry entry's line, or on a
+            comment-only line directly above — self-applied so both
+            entry points (explicit paths and paths=[]) agree."""
+            if self.name in reg_waivers.get(ln, ()):
+                return True
+            return (ln > 1 and reg_lines[ln - 2].lstrip().startswith("#")
+                    and self.name in reg_waivers.get(ln - 1, ()))
+        doc_sites, doc_line = _doc_sites(docs_path)
+        if not doc_line:
+            yield Finding(self.name, docs_path, 1,
+                          "docs/robustness.md lost its 'Sites:' list",
+                          span=None)
+            return
+
+        # call sites: always the full production tree under root. This
+        # pass scans its files itself (not via ctxs — a partial path
+        # selection must not report half the call sites as dead), so it
+        # also applies waivers itself: the framework's ctx-based filter
+        # only covers files the caller happened to select.
+        code_sites: dict[str, tuple[str, int, bool]] = {}
+        by_path = {c.path: c for c in ctxs}
+        for target in SCAN:
+            path = os.path.join(root, target)
+            if not os.path.exists(path):
+                continue
+            for fp in iter_py_files([path]):
+                ctx = by_path.get(os.path.abspath(fp))
+                if ctx is not None:   # already read+tokenized+parsed
+                    src, waivers = ctx.src, ctx.waivers
+                    spans = _stmt_spans(ctx.tree)
+                else:
+                    src = open(fp, encoding="utf-8").read()
+                    waivers = extract_waivers(src)
+                    try:
+                        spans = _stmt_spans(ast.parse(src))
+                    except SyntaxError:
+                        spans = {}
+                lines = src.splitlines()
+                # whole-text scan: `fire(\n  "site")` wraps across
+                # lines and a per-line findall would miss it (the
+                # regex's \s* crosses the newline)
+                for m in _CALL_RE.finditer(src):
+                    site = m.group(1)
+                    ln = src.count("\n", 0, m.start()) + 1
+                    # waiver honored across the enclosing statement's
+                    # span, or on a comment-ONLY line directly above
+                    # (same contract as FileContext.waived)
+                    lo, hi = spans.get(ln, (ln, ln))
+                    waived = any(self.name in waivers.get(i, ())
+                                 for i in range(lo, hi + 1))
+                    if not waived and lo > 1 and \
+                            lines[lo - 2].lstrip().startswith("#"):
+                        waived = self.name in waivers.get(lo - 1, ())
+                    prev = code_sites.get(site)
+                    # an unwaived call site outranks a waived one
+                    if prev is None or (prev[2] and not waived):
+                        code_sites[site] = (fp, ln, waived)
+
+        for site in sorted(set(code_sites) - set(registry)):
+            fp, ln, waived = code_sites[site]
+            if waived:
+                continue
+            # span=None: this pass applies waivers itself (above, with
+            # full statement-span semantics); handing a (ln-1, ln) span
+            # to the framework would let a trailing waiver on the
+            # previous statement leak onto this finding
+            yield Finding(
+                self.name, fp, ln,
+                f"FAULTS call site {site!r} is not in "
+                "resilience.FAULT_SITES — register it and document it "
+                "in docs/robustness.md",
+                span=None)
+        for site in sorted(set(registry) - set(code_sites)):
+            ln, _ = registry[site]
+            if reg_waived(ln):
+                continue
+            yield Finding(
+                self.name, reg_path, ln,
+                f"FAULT_SITES entry {site!r} has no call site — delete "
+                "it (and from docs/robustness.md)",
+                span=None)
+        for site in sorted(set(registry) - doc_sites):
+            ln, _ = registry[site]
+            if reg_waived(ln):   # one waiver covers the entry's drift
+                continue
+            yield Finding(
+                self.name, reg_path, ln,
+                f"FAULT_SITES entry {site!r} is missing from the "
+                "docs/robustness.md 'Sites:' list",
+                span=None)
+        for site in sorted(doc_sites - set(registry)):
+            yield Finding(
+                self.name, docs_path, doc_line,
+                f"docs/robustness.md documents site {site!r} that is "
+                "not in resilience.FAULT_SITES",
+                span=None)
+        for site, (ln, desc) in sorted(registry.items()):
+            if not desc:
+                yield Finding(
+                    self.name, reg_path, ln,
+                    f"FAULT_SITES entry {site!r} has no description",
+                    span=None)

@@ -1,0 +1,136 @@
+"""host-sync pass — device materialization inside a hot loop.
+
+Port of tools/check_host_syncs.py (the framework's single-pass
+ancestor; that file is now a deprecation shim delegating here) into
+the pass framework, widened from its 7-module allowlist to the whole
+tree. The TPU sits behind a tunnel: every device->host
+materialization (`float()` / `np.asarray()` / `.item()` /
+`jax.device_get`) costs ~tens of ms of round-trip latency, and one of
+those inside a loop serializes the async dispatch pipeline (CLAUDE.md;
+round 5 found a per-iteration `float()` in the gpipe clip path this
+way).
+
+Scope-aware where the ancestor was purely lexical: a function or
+lambda *defined* inside a loop opens a new dynamic scope — its body
+does not run once per loop iteration at definition time, so loop depth
+resets there (the ancestor flagged closure bodies defined in loops;
+per-file waiver noise at whole-tree scale would have drowned the
+signal).
+
+Static and approximate BY DESIGN: it cannot prove a value is a device
+array, so it flags the call pattern and relies on waivers for the
+deliberate cases (display-boundary materializations, host-side ndarray
+normalization, text parsing). The waiver reason is part of the
+contract: the author claims, in the diff, that the sync is intentional
+and boundary-rate — or that the operand never lives on device.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from . import Finding, FileContext, LintPass, register
+
+# call shapes that materialize a device value on the host
+_NAME_CALLS = {"float"}                      # float(x)
+_ATTR_CALLS = {                              # module.attr(x)
+    ("np", "asarray"), ("np", "array"), ("numpy", "asarray"),
+    ("numpy", "array"), ("jax", "device_get"),
+}
+_METHOD_CALLS = {"item"}                     # x.item()
+
+# comprehensions/genexprs ARE loops: `[float(l) for l in losses]` pays
+# one RTT per element just like the for-statement spelling
+_LOOPS = (ast.For, ast.While, ast.AsyncFor,
+          ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+# a def/lambda body is a new dynamic scope: defining it inside a loop
+# does not execute it inside the loop
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def call_kind(node: ast.Call) -> str | None:
+    fn = node.func
+    # a literal operand is never a device value: float("nan"),
+    # np.asarray(0.5) and friends are constant folding, not syncs
+    if node.args and isinstance(node.args[0], ast.Constant):
+        return None
+    if isinstance(fn, ast.Name) and fn.id in _NAME_CALLS:
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        if isinstance(fn.value, ast.Name) and (fn.value.id,
+                                               fn.attr) in _ATTR_CALLS:
+            return f"{fn.value.id}.{fn.attr}"
+        if fn.attr in _METHOD_CALLS and not node.args:
+            return f".{fn.attr}()"
+    return None
+
+
+@register
+class HostSyncPass(LintPass):
+    name = "host-sync"
+    description = ("float()/np.asarray()/.item()/device_get inside a "
+                   "loop — one tunnel RTT per iteration")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        findings: list[Finding] = []
+
+        def visit(node: ast.AST, depth: int,
+                  stmt: ast.stmt | None) -> None:
+            """Process `node` at loop depth `depth` (already includes
+            this node's own loop contribution), then its children."""
+            if isinstance(node, ast.stmt):
+                stmt = node
+            if depth > 0 and isinstance(node, ast.Call):
+                kind = call_kind(node)
+                if kind is not None:
+                    findings.append(Finding(
+                        self.name, ctx.path, node.lineno,
+                        f"{kind} inside a loop — a device value here "
+                        "costs one tunnel RTT per iteration; keep it "
+                        "on device, or waive with "
+                        "`# lint: ok(host-sync) — reason` if the sync "
+                        "is deliberate and boundary-rate (or the "
+                        "operand is host data)",
+                        span=(ctx.span_of(stmt) if stmt is not None
+                              else None),
+                        detail=kind))
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                # the iterable is evaluated ONCE, before the first
+                # iteration — only target/body/orelse run per pass.
+                # descend (not visit): a comprehension AS the iterable
+                # still loops over its own elements
+                descend(node.iter, depth - 1, stmt)
+                for child in [node.target, *node.body, *node.orelse]:
+                    descend(child, depth, stmt)
+                return
+            if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                 ast.GeneratorExp)):
+                # ditto the first generator's source sequence
+                gen0 = node.generators[0]
+                descend(gen0.iter, depth - 1, stmt)
+                rest = [gen0.target, *gen0.ifs, *node.generators[1:]]
+                if isinstance(node, ast.DictComp):
+                    rest += [node.key, node.value]
+                else:
+                    rest.append(node.elt)
+                for child in rest:
+                    descend(child, depth, stmt)
+                return
+            for child in ast.iter_child_nodes(node):
+                descend(child, depth, stmt)
+
+        def descend(child: ast.AST, depth: int,
+                    stmt: ast.stmt | None) -> None:
+            if isinstance(child, _SCOPES):
+                # a def/lambda body is a new dynamic scope — loop
+                # depth does not carry into it
+                visit(child, 0, stmt)
+            else:
+                visit(child,
+                      depth + (1 if isinstance(child, _LOOPS) else 0),
+                      stmt)
+
+        visit(ctx.tree, 0, None)
+        yield from findings

@@ -1,0 +1,81 @@
+"""reference-citation pass — module docstrings must cite the reference.
+
+The load-bearing repo convention (CLAUDE.md): every module docstring
+under caffe_mpi_tpu/ cites the reference files (`file:line`) it
+replaces — e.g. solver/solver.py cites src/caffe/solver.cpp:187-351 —
+and explains the TPU-native design choice. Until now that was enforced
+only by review; this pass makes it mechanical: the docstring must
+contain at least one source-file token (path with a known source
+extension, brace-groups like `{cpp,cu}` included, `:line` ranges
+encouraged). Modules that are genuinely TPU-native with no reference
+analogue say so in a waiver: `# lint: ok(reference-citation) — reason`
+on the line above (or the line after) the docstring.
+
+Scope: files under the caffe_mpi_tpu package tree (plus anything
+scanned from outside the repo, e.g. test fixtures). Trivial modules —
+no docstring AND no function/class definitions (re-export __init__
+shims) — are exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from . import Finding, FileContext, LintPass, register
+
+_EXT = r"(?:cpp|cc|cu|cuh|hpp|hh|h|py|proto|prototxt|sh|md)"
+# a path-ish token ending in a source extension; `{cpp,cu}` brace
+# alternation is the repo's multi-file idiom, and no trailing \b — a
+# closing brace has no word boundary against the following space
+CITATION_RE = re.compile(
+    r"[\w/{},\.\-]*\.(?:%s|\{%s(?:,%s)*\})(?::\d[\d\-,]*)?" % (
+        _EXT, _EXT, _EXT))
+
+
+@register
+class ReferenceCitationPass(LintPass):
+    name = "reference-citation"
+    description = ("module docstrings under caffe_mpi_tpu/ must cite "
+                   "the reference file(:line) they replace")
+
+    def _in_scope(self, ctx: FileContext) -> bool:
+        rel = ctx.rel
+        if rel == ctx.path:      # outside the repo root: fixture mode
+            return True
+        return rel.split("/")[0] == "caffe_mpi_tpu"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not self._in_scope(ctx):
+            return
+        tree = ctx.tree
+        doc = ast.get_docstring(tree, clean=False)
+        has_defs = any(isinstance(s, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.ClassDef))
+                       for s in tree.body)
+        if doc is None:
+            if not has_defs:
+                return           # trivial re-export shim
+            yield Finding(
+                self.name, ctx.path, 1,
+                "module has no docstring — add one citing the "
+                "reference file(:line) it replaces and the TPU-native "
+                "design choice (CLAUDE.md convention), or waive with "
+                "`# lint: ok(reference-citation) — reason`",
+                span=(1, 2))
+            return
+        if CITATION_RE.search(doc):
+            return
+        stmt = tree.body[0]
+        end = getattr(stmt, "end_lineno", stmt.lineno) or stmt.lineno
+        # the docstring is the first statement: a leading comment block
+        # (anywhere above it) is the natural waiver placement
+        yield Finding(
+            self.name, ctx.path, stmt.lineno,
+            "module docstring cites no reference file — name the "
+            "reference source (`file:line`) this module replaces "
+            "(CLAUDE.md convention); if it is TPU-native with no "
+            "analogue, waive with `# lint: ok(reference-citation) — "
+            "reason`",
+            span=(1, end + 1))

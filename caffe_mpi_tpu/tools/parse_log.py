@@ -36,6 +36,7 @@ def parse(path: str):
             if m:
                 last_iter = int(m["iter"])
                 train_rows.append({
+                    # lint: ok(host-sync) — parsing log text, host strings
                     "NumIters": last_iter,
                     "LearningRate": float(m["lr"]),
                     "loss": float(m["loss"]),
@@ -46,6 +47,7 @@ def parse(path: str):
             m = TEST_RE.search(line)
             if m:
                 test_rows.append({
+                    # lint: ok(host-sync) — parsing log text, host strings
                     "NumIters": last_iter,
                     "TestNet": int(m["net"]),
                     m["blob"]: float(m["value"]),

@@ -1,3 +1,5 @@
+# lint: ok(reference-citation) — TPU-native: the reference compiles AOT
+# with nvcc and has no JIT compilation step to cache
 """Persistent XLA compilation cache setup (shared by the CLI and bench).
 
 The AlexNet-class training step costs ~20-40s to compile on TPU; a warm

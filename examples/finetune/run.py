@@ -20,6 +20,8 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import getpass
+import tempfile
 
 import numpy as np
 
@@ -54,9 +56,15 @@ def make_feed(batch, coarse: bool, seed_base=0):
 def make_solver(text, max_iter, lr=0.05):
     from caffe_mpi_tpu.proto import NetParameter, SolverParameter
     from caffe_mpi_tpu.solver import Solver
+    # snapshot under tmp: a default ("snapshot") prefix would litter the
+    # repo root with the after-train snapshot + run journal
+    snap = os.path.join(tempfile.gettempdir(),
+                        f"caffe_tpu_examples-{getpass.getuser()}",
+                        "finetune", "snap")
     sp = SolverParameter.from_text(
         f'base_lr: {lr} momentum: 0.9 lr_policy: "fixed" '
-        f'max_iter: {max_iter} display: 50 random_seed: 5')
+        f'max_iter: {max_iter} display: 50 random_seed: 5 '
+        f'snapshot_prefix: "{snap}"')
     sp.net_param = NetParameter.from_text(text)
     return Solver(sp)
 

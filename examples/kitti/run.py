@@ -21,6 +21,8 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import getpass
+import tempfile
 
 import numpy as np
 
@@ -84,10 +86,16 @@ def main(argv=None) -> int:
 
     # the reference detectnet_solver.prototxt recipe (Adam, fixed-ish lr),
     # scaled down
+    # snapshot under tmp: a default ("snapshot") prefix would litter the
+    # repo root with the after-train snapshot + run journal
+    snap = os.path.join(tempfile.gettempdir(),
+                        f"caffe_tpu_examples-{getpass.getuser()}",
+                        "kitti", "snap")
     sp = SolverParameter.from_text(
         'type: "Adam" base_lr: 0.001 momentum: 0.9 momentum2: 0.999\n'
         'lr_policy: "fixed" display: 50\n'
-        f'max_iter: {args.max_iter} random_seed: 3')
+        f'max_iter: {args.max_iter} random_seed: 3\n'
+        f'snapshot_prefix: "{snap}"')
     sp.net_param = NetParameter.from_file(
         "examples/kitti/detectnet_tiny.prototxt")
     solver = Solver(sp)

@@ -601,16 +601,18 @@ class TestFeeder:
         for it in range(4):
             np.testing.assert_array_equal(f1(it)["label"], f2(it)["label"])
 
-    def test_trains_with_solver(self):
+    def test_trains_with_solver(self, tmp_path):
         from caffe_mpi_tpu.proto import NetParameter, SolverParameter
         from caffe_mpi_tpu.solver import Solver
         ds = SyntheticDataset(128, shape=(1, 8, 8), classes=4, noise=0.1)
         tf = DataTransformer(
             TransformationParameter.from_text("scale: 0.00390625"), "TRAIN")
         feeder = Feeder(ds, tf, batch_size=16, threads=2)
+        # snapshot_prefix pinned to tmp: solve() snapshots after train,
+        # and the default "snapshot" prefix litters the repo root
         sp = SolverParameter.from_text(
             'base_lr: 0.05 momentum: 0.9 lr_policy: "fixed" max_iter: 30 '
-            'type: "SGD"')
+            f'type: "SGD" snapshot_prefix: "{tmp_path}/snap"')
         sp.net_param = NetParameter.from_text("""
         layer { name: "in" type: "Input" top: "data" top: "label"
                 input_param { shape { dim: 16 dim: 1 dim: 8 dim: 8 }

@@ -1,88 +1,50 @@
-"""Doc-drift tripwires (ISSUE 4 satellite): the fault-injection site
-list is load-bearing operator documentation — a site added at a call
-site but missing from docs/robustness.md (or documented but deleted
-from the code) silently rots the runbook. Three sources of truth are
-held equal:
+"""Doc-drift tripwires — THIN WRAPPER over the lint framework's
+doc-drift pass (ISSUE 5: one enforcement path, two entry points; the
+substance lives in caffe_mpi_tpu/tools/lint/doc_drift.py and is also
+reachable as `python -m caffe_mpi_tpu.tools.lint --select doc-drift`).
 
-  1. the registry: `utils/resilience.FAULT_SITES`
-  2. the docs:     the `Sites:` list in docs/robustness.md
-  3. the code:     literal site names at FAULTS call sites
-
-Pure text/AST checks — no jax, no device work; tier-1 cheap.
+Held equal by the pass: the `FAULT_SITES` registry in
+utils/resilience.py, the `Sites:` list in docs/robustness.md, and the
+literal site names at FAULTS call sites. Pure text/AST — no jax, no
+device work; tier-1 cheap.
 """
 
 import os
-import re
 
-from caffe_mpi_tpu.utils.resilience import FAULT_SITES
+from caffe_mpi_tpu.tools import lint
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# every FaultPlane entry point a production call site can name a site
-# through (fire/fire_at and the one-line helpers)
-_HELPERS = ("fire", "fire_at", "active", "maybe_raise", "maybe_stall",
-            "maybe_exit", "corrupt_file", "corrupt_bytes")
-_CALL_RE = re.compile(
-    r"\.(?:%s)\(\s*[\"']([a-z_]+)[\"']" % "|".join(_HELPERS))
-
-# source trees whose FAULTS call sites are production injection points
-# (tests configure sites by string; they are consumers, not sites)
-_SCAN = ("caffe_mpi_tpu", "tools", "bench.py")
-
-
-def _doc_sites() -> set[str]:
-    with open(os.path.join(_ROOT, "docs", "robustness.md")) as f:
-        text = f.read()
-    m = re.search(r"Sites:\s*(.*?)\.\s", text, re.DOTALL)
-    assert m, "docs/robustness.md lost its 'Sites:' list"
-    return set(re.findall(r"`([a-z_]+)`", m.group(1)))
-
-
-def _code_sites() -> set[str]:
-    sites: set[str] = set()
-    for target in _SCAN:
-        path = os.path.join(_ROOT, target)
-        if os.path.isfile(path):
-            files = [path]
-        else:
-            files = [os.path.join(r, n) for r, _d, ns in os.walk(path)
-                     for n in ns if n.endswith(".py")
-                     and "__pycache__" not in r]
-        for fp in files:
-            with open(fp) as f:
-                sites.update(_CALL_RE.findall(f.read()))
-    return sites
-
 
 class TestFaultSiteDrift:
-    def test_docs_match_registry(self):
-        assert _doc_sites() == set(FAULT_SITES), (
-            "docs/robustness.md 'Sites:' list and "
-            "resilience.FAULT_SITES disagree")
+    def test_registry_docs_and_call_sites_agree(self):
+        """The doc-drift pass holds registry == docs == call sites (and
+        every registry entry described); any drift is a finding."""
+        findings = lint.run_lint(paths=[], select=["doc-drift"],
+                                 root=_ROOT)
+        assert findings == [], "\n".join(f.format(_ROOT) for f in findings)
 
-    def test_call_sites_match_registry(self):
-        code = _code_sites()
-        undocumented = code - set(FAULT_SITES)
-        assert not undocumented, (
-            f"FAULTS call sites not in FAULT_SITES: {sorted(undocumented)}"
-            " — register them (and document in docs/robustness.md)")
-        dead = set(FAULT_SITES) - code
-        assert not dead, (
-            f"FAULT_SITES entries with no call site: {sorted(dead)}"
-            " — delete them (and from docs/robustness.md)")
-
-    def test_registry_entries_described(self):
-        for site, desc in FAULT_SITES.items():
-            assert isinstance(desc, str) and desc, site
+    def test_registry_importable_and_matches_ast_view(self):
+        """The pass reads FAULT_SITES by AST (works without the package
+        importable); the real import must agree with that view."""
+        from caffe_mpi_tpu.tools.lint.doc_drift import (REGISTRY_FILE,
+                                                        _registry_sites)
+        from caffe_mpi_tpu.utils.resilience import FAULT_SITES
+        sites, line = _registry_sites(os.path.join(_ROOT, REGISTRY_FILE))
+        assert line > 0
+        assert set(sites) == set(FAULT_SITES)
+        for site, (_, desc) in sites.items():
+            assert desc == FAULT_SITES[site], site
 
 
 class TestLintCoverage:
-    def test_guard_and_quarantine_paths_are_linted(self):
-        """check_host_syncs.py must keep the ISSUE-4 hot paths in its
-        default target list (the lint is tier-1 via
-        tests/test_host_sync_lint.py — dropping a target silently
-        un-guards it)."""
+    def test_hot_paths_stay_in_the_whole_tree_scan(self):
+        """The framework's default scan must keep covering the ISSUE-3/4
+        hot paths (they are a subset of the whole-tree roots — dropping
+        a root from DEFAULT_SCAN silently un-guards them), and the
+        legacy shim must keep naming them for muscle memory."""
         import importlib.util
+        assert lint.DEFAULT_SCAN[0] == "caffe_mpi_tpu"
         spec = importlib.util.spec_from_file_location(
             "check_host_syncs",
             os.path.join(_ROOT, "tools", "check_host_syncs.py"))

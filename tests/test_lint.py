@@ -1,0 +1,692 @@
+"""tpulint framework (ISSUE 5): every pass catches its seeded bug,
+honors its waiver, and a misspelled waiver still fails; the shipped
+tree is lint-clean, fast, and checkable without jax (the suite must
+survive a dead tunnel).
+
+Fixture convention: per pass, one file seeding a known violation and
+one seeding the same pattern waived with
+`# lint: ok(<pass>) — reason`.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+from caffe_mpi_tpu.tools import lint
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ALL_PASSES = ("host-sync", "traced-control-flow", "concrete-init",
+              "gated-imports", "reference-citation", "doc-drift")
+
+
+def _write(tmp_path, name, src):
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return str(p)
+
+
+def _run(paths, select, root=None):
+    return lint.run_lint(paths=paths, select=list(select),
+                         root=root or _ROOT)
+
+
+def _names(findings):
+    return sorted({f.pass_name for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# registry + CLI surface
+
+def test_all_tentpole_passes_registered():
+    lint._load_passes()
+    for name in ALL_PASSES:
+        assert name in lint.REGISTRY, name
+        assert lint.REGISTRY[name].description
+
+
+def test_shipped_tree_is_clean_fast_and_jax_free():
+    """`python -m caffe_mpi_tpu.tools.lint` exits 0 on the shipped
+    tree, in under 5 s, with jax imports poisoned — the whole suite
+    stays usable while the tunnel is down."""
+    t0 = time.monotonic()
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import sys\n"
+         "for m in ('jax', 'jaxlib'):\n"
+         "    sys.modules[m] = None\n"  # any `import jax` now raises
+         "from caffe_mpi_tpu.tools.lint import main\n"
+         "raise SystemExit(main([]))"],
+        cwd=_ROOT, capture_output=True, text=True, timeout=60,
+        env=dict(os.environ, PYTHONPATH=_ROOT))
+    elapsed = time.monotonic() - t0
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert elapsed < 5.0, f"lint took {elapsed:.1f}s (budget 5s)"
+
+
+def test_cli_select_unknown_pass_is_usage_error():
+    assert lint.main(["--select", "no-such-pass"]) == 2
+
+
+def test_cli_nonexistent_path_is_usage_error_not_false_clean(capsys):
+    """A typo'd path must NOT exit 0 ('clean') — that is the one
+    failure mode a tripwire cannot afford — nor crash with a raw
+    traceback."""
+    assert lint.main(["caffe_mpi_tpuu"]) == 2       # typo'd dir
+    assert lint.main(["no_such_file.py"]) == 2
+    err = capsys.readouterr().err
+    assert "do not exist" in err
+
+
+def test_default_scan_tolerates_roots_without_bench(tmp_path):
+    """run_lint(root=fixture) must not crash when the root lacks
+    DEFAULT_SCAN entries like bench.py."""
+    _write(tmp_path, "caffe_mpi_tpu/ok.py", """
+        '''Replaces nothing.py:1 — fixture.'''
+    """)
+    assert lint.run_lint(root=str(tmp_path)) == []
+
+
+def test_cli_json_output(tmp_path, capsys):
+    bad = _write(tmp_path, "bad.py", """
+        def f(xs):
+            return [float(x) for x in xs]
+    """)
+    rc = lint.main(["--select", "host-sync", "--json", bad])
+    assert rc == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out and out[0]["pass"] == "host-sync"
+    assert out[0]["line"] == 3
+
+
+def test_syntax_error_is_surfaced_not_swallowed(tmp_path):
+    p = _write(tmp_path, "broken.py", "def oops(:\n")
+    findings = _run([p], ["host-sync"])
+    assert len(findings) == 1
+    assert findings[0].pass_name == "syntax"
+    assert "SYNTAX ERROR" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# host-sync
+
+def test_host_sync_catches_seeded_bug(tmp_path):
+    p = _write(tmp_path, "hot.py", """
+        import numpy as np
+
+        def train(losses):
+            total = 0.0
+            for l in losses:
+                total += float(l)
+            while losses:
+                x = np.asarray(losses.pop())
+                y = losses[0].item()
+            return total, float(total)     # outside any loop: clean
+    """)
+    kinds = sorted(f.detail for f in _run([p], ["host-sync"]))
+    assert kinds == [".item()", "float", "np.asarray"]
+
+
+def test_host_sync_honors_waiver_and_legacy_spelling(tmp_path):
+    p = _write(tmp_path, "waived.py", """
+        import numpy as np
+
+        def display(window):
+            for l in window:
+                s = float(l)  # lint: ok(host-sync) — display boundary
+                v = np.asarray(l)  # host-sync: ok (legacy spelling)
+    """)
+    assert _run([p], ["host-sync"]) == []
+
+
+def test_host_sync_scope_aware(tmp_path):
+    """A function/lambda DEFINED inside a loop is a new dynamic scope
+    (not executed per iteration at def time), and a for-loop's iterable
+    is evaluated once — neither is a per-iteration sync. Calls inside
+    the defined function still count when IT loops."""
+    p = _write(tmp_path, "scopes.py", """
+        import numpy as np
+
+        def build(schedule, blobs):
+            cbs = []
+            for s in schedule:
+                def cb(v):
+                    return float(v)        # def-time: not in the loop
+                cbs.append(cb)
+            for row in np.asarray(blobs):  # iterable: evaluated once
+                pass
+            def worker(vals):
+                return [v.item() for v in vals]   # still a real loop
+            return cbs, worker
+    """)
+    findings = _run([p], ["host-sync"])
+    assert [(f.line, f.detail) for f in findings] == [(13, ".item()")]
+
+
+def test_host_sync_comprehension_as_for_iterable_still_counts(tmp_path):
+    """A comprehension used AS a for-loop's iterable is evaluated once
+    but still loops over its own elements — the per-element sync must
+    not escape through the for-header position."""
+    p = _write(tmp_path, "itercomp.py", """
+        def drain(losses):
+            total = 0.0
+            for l in [float(x) for x in losses]:
+                total += l
+            for l in sum(v.item() for v in losses):   # nested in call
+                total += l
+            return total
+    """)
+    kinds = sorted(f.detail for f in _run([p], ["host-sync"]))
+    assert kinds == [".item()", "float"]
+
+
+# ---------------------------------------------------------------------------
+# traced-control-flow
+
+_TRACED_BAD = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        if jnp.sum(x) > 0:
+            x = x + 1
+        n = int(jnp.max(x))
+        return helper(x), n
+
+    def helper(x):
+        while jnp.any(x > 0):
+            x = x - 1
+        return x
+
+    def host_only(x):
+        if jnp.sum(x) > 0:     # not reachable from any traced root
+            return x
+        return -x
+"""
+
+
+def test_traced_control_flow_catches_seeded_bug(tmp_path):
+    p = _write(tmp_path, "traced.py", _TRACED_BAD)
+    findings = _run([p], ["traced-control-flow"])
+    lines = sorted(f.line for f in findings)
+    assert lines == [7, 9, 13]   # if, int(), while-in-callee; host_only clean
+
+
+def test_traced_control_flow_honors_waiver_and_whitelist(tmp_path):
+    p = _write(tmp_path, "waived.py", """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x, training):
+            # lint: ok(traced-control-flow) — static arg, concrete at trace
+            if jnp.asarray(training):
+                x = x + 1
+            if jnp.issubdtype(x.dtype, jnp.floating):  # metadata: fine
+                x = x * 2
+            return x
+    """)
+    assert _run([p], ["traced-control-flow"]) == []
+
+
+def test_traced_control_flow_sees_scan_bodies(tmp_path):
+    p = _write(tmp_path, "scanbody.py", """
+        from jax import lax
+        import jax.numpy as jnp
+
+        def outer(xs):
+            def body(carry, x):
+                if jnp.abs(x) > 1:       # traced: scan body
+                    carry = carry + x
+                return carry, x
+            return lax.scan(body, 0.0, xs)
+    """)
+    findings = _run([p], ["traced-control-flow"])
+    assert [f.line for f in findings] == [7]
+
+
+# ---------------------------------------------------------------------------
+# concrete-init
+
+def test_concrete_init_catches_seeded_bug(tmp_path):
+    p = _write(tmp_path, "init.py", """
+        import numpy as np
+        import jax.numpy as jnp
+        from jax import lax
+
+        def bad_pool(x):
+            return lax.reduce_window(x, jnp.zeros(()), lax.add,
+                                     window_dimensions=(1,),
+                                     window_strides=(1,),
+                                     padding=((0, 0),))
+
+        def good_pool(x):
+            return lax.reduce_window(x, np.zeros((), x.dtype)[()],
+                                     lax.add, window_dimensions=(1,),
+                                     window_strides=(1,),
+                                     padding=((0, 0),))
+
+        def bad_scan(xs):
+            return lax.scan(lambda c, x: (c + x, c), jnp.zeros(()), xs)
+
+        def good_scan(acc0, xs):
+            return lax.scan(lambda c, x: (c + x, c), acc0, xs)
+    """)
+    findings = _run([p], ["concrete-init"])
+    assert sorted(f.line for f in findings) == [7, 19]
+
+
+def test_concrete_init_honors_waiver(tmp_path):
+    p = _write(tmp_path, "waived.py", """
+        import jax.numpy as jnp
+        from jax import lax
+
+        def pool(x):
+            # lint: ok(concrete-init) — forward-only op, never differentiated
+            return lax.reduce_window(x, jnp.zeros(()), lax.max,
+                                     window_dimensions=(1,),
+                                     window_strides=(1,),
+                                     padding=((0, 0),))
+    """)
+    assert _run([p], ["concrete-init"]) == []
+
+
+# ---------------------------------------------------------------------------
+# gated-imports
+
+def test_gated_imports_catches_seeded_bug(tmp_path):
+    p = _write(tmp_path, "db.py", """
+        import lmdb
+
+        def open_db(path):
+            return lmdb.open(path)
+    """)
+    findings = _run([p], ["gated-imports"])
+    assert len(findings) == 1 and findings[0].line == 2
+
+
+def test_gated_imports_honors_gate_waiver_and_tests_exemption(tmp_path):
+    gated = _write(tmp_path, "gated.py", """
+        try:
+            import lmdb
+        except ImportError:
+            lmdb = None
+
+        import flask  # lint: ok(gated-imports) — demo-only module
+
+        def ready():
+            return lmdb is not None
+    """)
+    in_tests = _write(tmp_path, "tests/test_oracle.py", """
+        import torch
+
+        def test_x():
+            assert torch is not None
+    """)
+    assert _run([gated, in_tests], ["gated-imports"]) == []
+
+
+# ---------------------------------------------------------------------------
+# reference-citation
+
+def test_reference_citation_catches_seeded_bug(tmp_path):
+    p = _write(tmp_path, "mod.py", '''
+        """A module docstring that cites nothing."""
+
+        def f():
+            return 1
+    ''')
+    findings = _run([p], ["reference-citation"])
+    assert len(findings) == 1 and findings[0].line == 2
+
+
+def test_reference_citation_honors_waiver_citation_and_trivial(tmp_path):
+    waived = _write(tmp_path, "native.py", '''
+        # lint: ok(reference-citation) — TPU-native, no reference analogue
+        """A genuinely new subsystem."""
+
+        def f():
+            return 1
+    ''')
+    cited = _write(tmp_path, "cited.py", '''
+        """Replaces src/caffe/solver.cpp:187-351 with a fused step.
+
+        Brace-group citations like src/caffe/layers/{relu,elu}_layer.{cpp,cu}
+        count too.
+        """
+
+        def f():
+            return 1
+    ''')
+    trivial = _write(tmp_path, "__init__.py", """
+        from os import path
+        X = 1
+    """)
+    assert _run([waived, cited, trivial], ["reference-citation"]) == []
+
+
+# ---------------------------------------------------------------------------
+# doc-drift (needs a mini tree: registry + docs + call sites)
+
+def _mini_tree(tmp_path, extra_call="", ghost_entry=False):
+    ghost = '\n            "ghost_site": "never fired",' if ghost_entry \
+        else ""
+    _write(tmp_path, "caffe_mpi_tpu/utils/resilience.py", f"""
+        FAULT_SITES = {{
+            "feeder_read": "reader raises once",{ghost}
+        }}
+    """)
+    _write(tmp_path, "docs/robustness.md", """
+        Fault plane. Sites: `feeder_read`. More prose.
+    """)
+    _write(tmp_path, "caffe_mpi_tpu/runtime.py", f"""
+        def read(faults, i):
+            faults.fire("feeder_read")
+            {extra_call}
+            return i
+    """)
+    return str(tmp_path)
+
+
+def test_doc_drift_catches_undocumented_call_site(tmp_path):
+    root = _mini_tree(tmp_path, 'faults.fire("surprise_site")')
+    findings = _run([os.path.join(root, "caffe_mpi_tpu")],
+                    ["doc-drift"], root=root)
+    assert len(findings) == 1
+    assert "surprise_site" in findings[0].message
+
+
+def test_doc_drift_catches_dead_registry_entry(tmp_path):
+    root = _mini_tree(tmp_path, ghost_entry=True)
+    findings = _run([os.path.join(root, "caffe_mpi_tpu")],
+                    ["doc-drift"], root=root)
+    msgs = "\n".join(f.message for f in findings)
+    assert "ghost_site" in msgs
+
+
+def test_doc_drift_honors_waiver(tmp_path):
+    root = _mini_tree(
+        tmp_path,
+        'faults.fire("surprise_site")  '
+        "# lint: ok(doc-drift) — staged rollout, registered next PR")
+    findings = _run([os.path.join(root, "caffe_mpi_tpu")],
+                    ["doc-drift"], root=root)
+    assert findings == []
+
+
+def test_doc_drift_registry_waiver_agrees_across_entry_points(tmp_path):
+    """A waived dead registry entry (staged rollout: call site lands
+    next PR) must be clean via BOTH explicit paths and paths=[]."""
+    root = _mini_tree(
+        tmp_path,
+        ghost_entry=True)
+    # waive the ghost entry on its registry line
+    reg = os.path.join(root, "caffe_mpi_tpu/utils/resilience.py")
+    src = open(reg).read().replace(
+        '"ghost_site": "never fired",',
+        '"ghost_site": "never fired",  '
+        "# lint: ok(doc-drift) — call site lands next PR")
+    open(reg, "w").write(src)
+    for paths in ([os.path.join(root, "caffe_mpi_tpu")], []):
+        assert _run(paths, ["doc-drift"], root=root) == [], paths
+
+
+def test_doc_drift_clean_tree_is_clean(tmp_path):
+    root = _mini_tree(tmp_path)
+    assert _run([os.path.join(root, "caffe_mpi_tpu")],
+                ["doc-drift"], root=root) == []
+
+
+def test_doc_drift_waiver_honored_on_empty_path_selection(tmp_path):
+    """The tier-1 wrapper (tests/test_doc_drift.py) runs the pass with
+    paths=[]; waivers must hold there too, not only when the call-site
+    file happens to be in the scanned selection — one enforcement
+    path, two entry points."""
+    root = _mini_tree(
+        tmp_path,
+        'faults.fire("surprise_site")  '
+        "# lint: ok(doc-drift) — staged rollout, registered next PR")
+    assert _run([], ["doc-drift"], root=root) == []
+    # and the finding still fires without the waiver via paths=[]
+    root2 = _mini_tree(tmp_path / "b", 'faults.fire("surprise_site")')
+    findings = lint.run_lint(paths=[], select=["doc-drift"], root=root2)
+    assert len(findings) == 1 and "surprise_site" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# waiver grammar hard cases
+
+def test_misspelled_waiver_still_fails(tmp_path):
+    """A typo'd pass name neither suppresses the finding NOR passes
+    silently: the finding survives and the bad waiver is itself
+    reported."""
+    p = _write(tmp_path, "typo.py", """
+        def f(xs):
+            out = []
+            for x in xs:
+                out.append(float(x))  # lint: ok(host-sink) — oops
+            return out
+    """)
+    findings = _run([p], ["host-sync"])
+    names = _names(findings)
+    assert names == ["bad-waiver", "host-sync"], findings
+
+
+def test_waiver_for_other_pass_does_not_suppress(tmp_path):
+    p = _write(tmp_path, "wrongpass.py", """
+        def f(xs):
+            out = []
+            for x in xs:
+                out.append(float(x))  # lint: ok(gated-imports) — wrong pass
+            return out
+    """)
+    findings = _run([p], ["host-sync"])
+    assert _names(findings) == ["host-sync"]
+
+
+def test_waiver_grammar_inside_a_string_does_not_suppress(tmp_path):
+    """Text that merely QUOTES the waiver grammar (a message string, a
+    docstring) must not register as a waiver — only real comment
+    tokens count; otherwise a pass whose error message cites the
+    grammar would self-waive."""
+    p = _write(tmp_path, "quoted.py", """
+        def f(losses):
+            out = []
+            for l in losses:
+                out.append(("use # lint: ok(host-sync) to waive",
+                            float(l)))
+            return out
+    """)
+    findings = _run([p], ["host-sync"])
+    assert [f.detail for f in findings] == ["float"]
+
+
+def test_cli_non_py_file_is_usage_error_not_false_clean(tmp_path):
+    doc = tmp_path / "notes.md"
+    doc.write_text("# notes\n")
+    assert lint.main([str(doc)]) == 2
+
+
+def test_traced_control_flow_flags_lambda_body(tmp_path):
+    p = _write(tmp_path, "lam.py", """
+        import jax
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda x: bool(jnp.any(x)))
+    """)
+    findings = _run([p], ["traced-control-flow"])
+    assert [f.line for f in findings] == [5]
+
+
+def test_traced_control_flow_lambda_finding_is_waivable(tmp_path):
+    """A lambda body has no statements of its own; its findings anchor
+    waivers on the enclosing statement, so the documented grammar
+    works on jit-wrapped lambdas too."""
+    p = _write(tmp_path, "lamw.py", """
+        import jax
+        import jax.numpy as jnp
+
+        # lint: ok(traced-control-flow) — scalar pred, concrete at trace
+        f = jax.jit(lambda x: bool(jnp.any(x)))
+    """)
+    assert _run([p], ["traced-control-flow"]) == []
+
+
+def test_doc_drift_waiver_on_multiline_statement_span(tmp_path):
+    """The waiver grammar promises the whole statement span; a
+    trailing waiver on a multi-line fire(...) call must hold."""
+    root = _mini_tree(
+        tmp_path,
+        'faults.fire("surprise_site",\n'
+        '                        0)  '
+        "# lint: ok(doc-drift) — staged rollout")
+    assert _run([], ["doc-drift"], root=root) == []
+
+
+def test_gated_imports_type_checking_else_branch_not_gated(tmp_path):
+    p = _write(tmp_path, "tc.py", """
+        from typing import TYPE_CHECKING
+
+        if TYPE_CHECKING:
+            import lmdb          # never runs: gated
+        else:
+            import flask         # ALWAYS runs: must be flagged
+
+        def f():
+            return 0
+    """)
+    findings = _run([p], ["gated-imports"])
+    assert len(findings) == 1 and "flask" in findings[0].message
+
+
+def test_trailing_waiver_does_not_leak_to_next_statement(tmp_path):
+    """A trailing waiver belongs to ITS statement; the next statement's
+    'line directly above' placement only counts for comment-only
+    lines — otherwise one waiver silently suppresses two findings."""
+    p = _write(tmp_path, "leak.py", """
+        import numpy as np
+
+        def f(ls, ms):
+            out = []
+            for l, m in zip(ls, ms):
+                a = float(l)  # lint: ok(host-sync) — boundary
+                b = np.asarray(m)
+                out.append((a, b))
+            return out
+    """)
+    findings = _run([p], ["host-sync"])
+    assert [(f.line, f.detail) for f in findings] == [(8, "np.asarray")]
+
+
+def test_gated_imports_handler_and_finally_not_gated(tmp_path):
+    """Only the try BODY is protected by an ImportError handler; an
+    unguarded gated import in the except/finally blocks raises at
+    module-import time and must be flagged."""
+    p = _write(tmp_path, "tryparts.py", """
+        try:
+            import lmdb                  # gated: fine
+        except ImportError:
+            import torch                 # NOT protected: flagged
+        finally:
+            import flask                 # NOT protected: flagged
+
+        def f():
+            return 0
+    """)
+    findings = _run([p], ["gated-imports"])
+    assert sorted(f.line for f in findings) == [5, 7]
+
+
+def test_traced_control_flow_bool_in_test_reports_once(tmp_path):
+    """`if bool(jnp.any(x)):` is ONE defect — the branch flag consumes
+    the test subtree so the nested bool() does not double-report."""
+    p = _write(tmp_path, "dup.py", """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            if bool(jnp.any(x)):
+                x = x + 1
+            return x
+    """)
+    findings = _run([p], ["traced-control-flow"])
+    assert len(findings) == 1 and "`if`" in findings[0].message
+
+
+def test_doc_drift_unrelated_trailing_waiver_does_not_leak(tmp_path):
+    """A doc-drift waiver trailing the PREVIOUS statement must not
+    suppress a call-site finding on the next line — and both entry
+    points (explicit paths vs paths=[]) must agree."""
+    root = _mini_tree(
+        tmp_path,
+        "x = 1  # lint: ok(doc-drift) — unrelated\n"
+        '            faults.fire("surprise_site")')
+    for paths in ([os.path.join(root, "caffe_mpi_tpu")], []):
+        findings = _run(paths, ["doc-drift"], root=root)
+        assert len(findings) == 1, (paths, findings)
+        assert "surprise_site" in findings[0].message
+
+
+def test_traced_control_flow_partial_jit_is_a_root(tmp_path):
+    p = _write(tmp_path, "pjit.py", """
+        from functools import partial
+        import jax
+        import jax.numpy as jnp
+
+        @partial(jax.jit, static_argnums=0)
+        def step(n, x):
+            if jnp.sum(x) > 0:
+                x = x + n
+            return x
+    """)
+    findings = _run([p], ["traced-control-flow"])
+    assert [f.line for f in findings] == [8]
+
+
+def test_nested_waiver_does_not_suppress_header_finding(tmp_path):
+    """A finding anchored to a compound statement (if/while header)
+    spans only the HEADER — a waiver on some statement nested in the
+    body must not silently suppress it."""
+    p = _write(tmp_path, "hdr.py", """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x, idx):
+            if jnp.sum(x) > 0:
+                # lint: ok(traced-control-flow) — static index
+                y = int(jnp.argmax(x))
+                x = x + y
+            return x
+    """)
+    findings = _run([p], ["traced-control-flow"])
+    assert len(findings) == 1 and "`if`" in findings[0].message
+
+
+def test_doc_drift_sees_wrapped_call_sites(tmp_path):
+    """`fire(\\n \"site\")` wrapped across lines must still register as
+    a call site (whole-text scan, as the pre-framework test did)."""
+    root = _mini_tree(
+        tmp_path,
+        'faults.fire(\n                "surprise_site")')
+    findings = _run([], ["doc-drift"], root=root)
+    assert len(findings) == 1
+    assert "surprise_site" in findings[0].message
+
+
+def test_multi_pass_waiver(tmp_path):
+    p = _write(tmp_path, "multi.py", """
+        def f(xs):
+            out = []
+            for x in xs:
+                # lint: ok(host-sync, traced-control-flow) — host floats
+                out.append(float(x))
+            return out
+    """)
+    assert _run([p], ["host-sync", "traced-control-flow"]) == []

@@ -253,6 +253,9 @@ class TestEventBoundaries:
         # resume from the K-mode iter-6 snapshot and run to 14 at K=9:
         # trajectory must match the uninterrupted K=1 run
         c = make_solver(cfg + " step_chunk: 9")
+        # prefix pinned to tmp: the resumed run crosses the iter-12
+        # snapshot boundary, and the default prefix litters the repo root
+        c.sp.snapshot_prefix = str(tmp_path / "resume")
         c.restore(str(tmp_path / "k9_iter_6.solverstate"))
         assert c.iter == 6
         c.step(8, lambda it: data[it % 32])

@@ -1,55 +1,37 @@
 #!/usr/bin/env python
-"""Host-sync lint — mechanically catch the per-iteration-RTT bug class.
+"""DEPRECATION SHIM — the host-sync lint moved into the framework.
 
-The TPU in this environment sits behind a tunnel: every device->host
-materialization (`float()` / `np.asarray()` / `.item()` / `device_get`)
-costs ~tens of ms of round-trip latency, and one of those inside a hot
-loop serializes the whole async dispatch pipeline (CLAUDE.md; the
-Solver keeps losses on device between display intervals for exactly
-this reason, and round 5 found a per-iteration `float()` in the gpipe
-clip path by advisor review). This check finds the pattern
-mechanically: it walks the solver/parallel hot-path modules and flags
-host-materialization calls that are lexically inside a `for`/`while`
-loop, unless the enclosing statement carries an explicit
-`# host-sync: ok` waiver (display-boundary materializations, the one
-eval-harvest transfer per test net).
+This tool was the single-pass ancestor of `caffe_mpi_tpu.tools.lint`
+(ISSUE 5); the pass now lives at caffe_mpi_tpu/tools/lint/host_sync.py,
+is scope-aware, and covers the whole tree alongside four sibling
+passes. This file keeps the old entry points alive:
 
-Static and approximate BY DESIGN: it cannot prove a value is a device
-array, so it flags the call pattern and relies on waivers for the
-deliberate cases — a cheap tier-1 tripwire
-(tests/test_host_sync_lint.py), not a type system. The waiver is part
-of the contract: writing it forces the author to claim, in the diff,
-that the sync is intentional and boundary-rate.
-
-Usage:
     python tools/check_host_syncs.py [file-or-dir ...]
-Defaults to caffe_mpi_tpu/solver + caffe_mpi_tpu/parallel. Exits 1 if
-any finding.
+
+and the module surface (`scan_file`, `scan_paths`, `DEFAULT_TARGETS`,
+`WAIVER`) that tests/test_host_sync_lint.py and muscle memory rely on.
+New waivers should use the framework grammar
+(`# lint: ok(host-sync) — reason`); the legacy `# host-sync: ok`
+spelling keeps working.
+
+Prefer: python -m caffe_mpi_tpu.tools.lint --select host-sync [paths]
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:  # direct script/importlib execution
+    sys.path.insert(0, _ROOT)
+
+from caffe_mpi_tpu.tools import lint as _lint  # noqa: E402
+
 WAIVER = "# host-sync: ok"
 
-# call shapes that materialize a device value on the host
-_NAME_CALLS = {"float"}                      # float(x)
-_ATTR_CALLS = {                              # module.attr(x)
-    ("np", "asarray"), ("np", "array"), ("numpy", "asarray"),
-    ("numpy", "array"), ("jax", "device_get"),
-}
-_METHOD_CALLS = {"item"}                     # x.item()
-
-# feeder + resilience joined the targets with ISSUE 3: the feed queue's
-# retry loops and the watchdog/supervisor sit on the same dispatch hot
-# path as the solver, and a stray materialization there serializes the
-# pipeline just the same. ISSUE 4 added the guard/quarantine paths:
-# datasets + the LMDB/LevelDB cursors now run crc verification inside
-# the per-record hot loop, where an accidental device materialization
-# (or a future "let me just asarray this") would be paid per record.
+# kept for compat: tests assert these stay covered (they are a strict
+# subset of the framework's whole-tree default scan)
 DEFAULT_TARGETS = ("caffe_mpi_tpu/solver", "caffe_mpi_tpu/parallel",
                    "caffe_mpi_tpu/data/feeder.py",
                    "caffe_mpi_tpu/data/datasets.py",
@@ -57,87 +39,36 @@ DEFAULT_TARGETS = ("caffe_mpi_tpu/solver", "caffe_mpi_tpu/parallel",
                    "caffe_mpi_tpu/data/leveldb_io.py",
                    "caffe_mpi_tpu/utils/resilience.py")
 
-# comprehensions/genexprs ARE loops: `[float(l) for l in losses]` pays
-# one RTT per element just like the for-statement spelling
-_LOOPS = (ast.For, ast.While, ast.AsyncFor,
-          ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
-
-
-def _call_kind(node: ast.Call) -> str | None:
-    fn = node.func
-    if isinstance(fn, ast.Name) and fn.id in _NAME_CALLS:
-        return fn.id
-    if isinstance(fn, ast.Attribute):
-        if isinstance(fn.value, ast.Name) and (fn.value.id,
-                                               fn.attr) in _ATTR_CALLS:
-            return f"{fn.value.id}.{fn.attr}"
-        if fn.attr in _METHOD_CALLS and not node.args:
-            return f".{fn.attr}()"
-    return None
-
 
 def scan_file(path: str) -> list[tuple[str, int, str]]:
-    """Return (path, lineno, call) findings for one source file."""
-    with open(path) as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:  # surface, don't hide behind "no findings"
-        return [(path, e.lineno or 0, f"SYNTAX ERROR: {e.msg}")]
-    lines = src.splitlines()
-
-    def waived(stmt: ast.stmt) -> bool:
-        # accept the waiver anywhere in the statement's span, or on the
-        # comment line directly above it
-        end = getattr(stmt, "end_lineno", stmt.lineno) or stmt.lineno
-        return any(WAIVER in lines[ln - 1]
-                   for ln in range(max(stmt.lineno - 1, 1), end + 1)
-                   if ln - 1 < len(lines))
-
-    findings: list[tuple[str, int, str]] = []
-
-    def walk(node: ast.AST, loop_depth: int, stmt: ast.stmt | None) -> None:
-        for child in ast.iter_child_nodes(node):
-            d = loop_depth + (1 if isinstance(child, _LOOPS) else 0)
-            s = child if isinstance(child, ast.stmt) else stmt
-            if (loop_depth > 0 and isinstance(child, ast.Call)):
-                kind = _call_kind(child)
-                if kind is not None and (s is None or not waived(s)):
-                    findings.append((path, child.lineno, kind))
-            walk(child, d, s)
-
-    walk(tree, 0, None)
-    return findings
+    """Return (path, lineno, call-kind) findings for one source file
+    (legacy tuple shape; 'SYNTAX ERROR: ...' kind on a broken file)."""
+    return [(f.path, f.line, f.detail)
+            for f in _lint.run_pass_on_file("host-sync", path)]
 
 
 def scan_paths(paths) -> list[tuple[str, int, str]]:
     findings = []
-    for target in paths:
-        if os.path.isdir(target):
-            for root, _dirs, files in os.walk(target):
-                if "__pycache__" in root:
-                    continue
-                for name in sorted(files):
-                    if name.endswith(".py"):
-                        findings.extend(scan_file(os.path.join(root, name)))
-        elif target.endswith(".py"):
-            findings.extend(scan_file(target))
+    for path in _lint.iter_py_files(paths):
+        findings.extend(scan_file(path))
     return findings
 
 
 def main(argv=None) -> int:
     args = (argv if argv is not None else sys.argv[1:])
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    targets = args or [os.path.join(root, t) for t in DEFAULT_TARGETS]
+    targets = args or [os.path.join(_ROOT, t) for t in DEFAULT_TARGETS]
     findings = scan_paths(targets)
     for path, lineno, kind in findings:
-        rel = os.path.relpath(path, root)
+        rel = os.path.relpath(path, _ROOT)
         print(f"{rel}:{lineno}: {kind} inside a hot loop — a device "
               f"value here costs one tunnel RTT per iteration; keep it "
               f"on device, or mark the statement `{WAIVER}` if the "
               "sync is deliberate and boundary-rate")
     if findings:
         print(f"{len(findings)} host-sync finding(s)", file=sys.stderr)
+        print("note: this tool is a shim; prefer "
+              "`python -m caffe_mpi_tpu.tools.lint --select host-sync`",
+              file=sys.stderr)
         return 1
     return 0
 

@@ -68,6 +68,15 @@ EVAL_TEST_CHUNK = int(os.environ.get("CAFFE_BENCH_TEST_CHUNK", 4))
 # boundaries, each a 5-scalar transfer). Set 0 for the unguarded
 # program (renames the metric like every other knob).
 GUARD = os.environ.get("CAFFE_BENCH_GUARD", "1") != "0"
+# CAFFE_BENCH_MESH=all: run the headline config data-parallel over every
+# visible device with the overlapped bucketed reduction engaged (ISSUE 6,
+# solver reduce_overlap — parallel/reduction.py). The JSON line then
+# carries a "reduction" block: collectives_per_step + bucket_bytes from
+# the active plan and the HLO overlap-span proxy
+# (reduction.collective_stats over the compiled step). Default "" keeps
+# the 1-chip headline program unchanged; setting it renames the metric
+# like every other knob.
+MESH = os.environ.get("CAFFE_BENCH_MESH", "")
 _SOLVERS = {
     ("alexnet", "f32"): "models/alexnet/solver.prototxt",
     ("alexnet", "bf16"): "models/alexnet/solver_fp16.prototxt",
@@ -75,11 +84,12 @@ _SOLVERS = {
     ("resnet50", "bf16"): "models/resnet50/solver_fp16.prototxt",
 }
 _IS_DEBUG = (BATCH, ITERS, WARMUP, MODEL, DTYPE, STEP_CHUNK,
-             EVAL_TEST_ITER, EVAL_TEST_CHUNK, GUARD) != (
-                 256, 20, 3, "alexnet", "f32", 10, 8, 4, True)
+             EVAL_TEST_ITER, EVAL_TEST_CHUNK, GUARD, MESH) != (
+                 256, 20, 3, "alexnet", "f32", 10, 8, 4, True, "")
 METRIC = ("alexnet_b256_train_img_per_s_1chip" if not _IS_DEBUG
           else f"debug_{MODEL}_{DTYPE}_b{BATCH}_i{ITERS}_k{STEP_CHUNK}"
-               f"{'' if GUARD else '_noguard'}_train_img_per_s_1chip")
+               f"{'' if GUARD else '_noguard'}"
+               f"{f'_mesh_{MESH}' if MESH else ''}_train_img_per_s_1chip")
 
 
 def emit(value=None, vs_baseline=None, extra=None, error=None):
@@ -140,7 +150,21 @@ def run_bench():
     shapes = input_shapes(npar, batch=BATCH)
     sp.net = ""
     sp.net_param = npar
-    solver = Solver(sp, model_dir=_ROOT)
+    mesh_plan = None
+    if MESH:
+        if MESH != "all":
+            raise SystemExit(f"unknown CAFFE_BENCH_MESH={MESH!r}; "
+                             "supported: 'all'")
+        from caffe_mpi_tpu.parallel import MeshPlan, reduction
+        mesh_plan = MeshPlan.data_parallel()
+        sp.reduce_overlap = True
+        # same libtpu scheduler flags `caffe train -reduce_overlap`
+        # sets (no-op on CPU; nothing above has touched the device, so
+        # this lands before backend init) — the bench must measure the
+        # bucketed program WITH the latency-hiding scheduler, not the
+        # collectives serialized
+        reduction.apply_tpu_overlap_flags(os.environ)
+    solver = Solver(sp, model_dir=_ROOT, mesh=mesh_plan)
 
     feeds = synthetic_feeds(shapes, npar=npar)
     feed_fn = lambda it: feeds
@@ -224,6 +248,20 @@ def run_bench():
         "guard_syncs": guard_syncs,
     }
     extra.update(eval_extra)
+    if mesh_plan is not None:
+        # ISSUE 6 telemetry, computed OUTSIDE the timed region: the
+        # active bucket plan (collectives_per_step, bucket_bytes — or
+        # mode "implicit" + fallback_reason when the net couldn't
+        # engage) plus the HLO overlap-span proxy from a one-iteration
+        # compile (reduction.collective_stats; one extra XLA compile,
+        # after the headline number is already banked)
+        rstats = solver.reduction_stats() or {}
+        try:
+            rstats.update(reduction.collective_stats(
+                solver.step_hlo_text(feeds)))
+        except Exception as e:  # telemetry must not kill the headline
+            rstats["hlo_error"] = str(e)[-200:]
+        extra["reduction"] = rstats
     return round(img_s, 1), round(img_s / BASELINE_IMG_S, 2), extra
 
 

@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..parallel.mesh import axis_size as _axis_size
+
 
 def _block_attn(q, k, v, *, scale, mask=None):
     """One q-block x k-block attention with running-softmax stats.
@@ -91,7 +93,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     valid_len: global key positions >= valid_len are padding (the top-level
     wrapper pads uneven sequence lengths up to a multiple of the ring
     size); they are masked out of every block."""
-    n_dev = lax.axis_size(axis_name)
+    n_dev = _axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     scale = 1.0 / math.sqrt(q.shape[-1])
     block_len = q.shape[1]
@@ -171,7 +173,7 @@ def _merge_blocks(O, LSE, out_b, lse_b):
 
 
 def _ring_rotate(axis_name, *arrays):
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     perm = [(j, (j - 1) % n) for j in range(n)]
     return tuple(lax.ppermute(a, axis_name, perm) for a in arrays)
 
@@ -197,7 +199,7 @@ def _ring_flash_loop(q2, k2, v2, axis_name, causal, valid_len, interpret):
     from .flash_attention import flash_block
     from ..parallel.mesh import mark_varying
 
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     bh, s, d = q2.shape
     O = mark_varying(jnp.zeros((bh, s, d), jnp.float32), like=q2)
@@ -259,7 +261,7 @@ def _ring_flash_bwd(axis_name, causal, valid_len, interpret, res, dout):
     q2, k2, v2 = _to_heads2(q), _to_heads2(k), _to_heads2(v)
     out2, do2 = _to_heads2(out), _to_heads2(dout)
     delta = _delta(do2, out2)   # global rowsum(dO*O), shared by blocks
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
 
     dq = mark_varying(jnp.zeros(q2.shape, jnp.float32), like=q2)
@@ -335,7 +337,7 @@ def sequence_parallel_attention(q, k, v, mesh, *, seq_axis: str = "model",
     per-device memory stays O(S/n) with no (S/n)^2 score materialization.
     Padding then rounds the LOCAL shard up to the flash tile rule."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from ..parallel.mesh import shard_map  # jax-version shim
 
     n = mesh.shape[seq_axis]
     s = q.shape[1]

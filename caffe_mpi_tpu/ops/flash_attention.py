@@ -233,9 +233,10 @@ def _sds(shape, dtype, like):
     axis set of `like` — under shard_map (ring attention) outputs must
     declare how they vary over mesh axes; outside it the vma set is
     empty/absent and a plain struct is produced."""
-    vma = getattr(jax.typeof(like), "vma", None)
-    if vma:
-        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    from ..parallel.mesh import vma as _vma  # jax-version typeof shim
+    axes = _vma(like)
+    if axes:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=axes)
     return jax.ShapeDtypeStruct(shape, dtype)
 
 

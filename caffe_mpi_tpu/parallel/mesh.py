@@ -39,11 +39,65 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 log = logging.getLogger("caffe_mpi_tpu.parallel")
 
 
+def typeof(x):
+    """`jax.typeof` appeared after 0.4.x (this environment pins jax
+    0.4.37); fall back to the abstract value, which carries the same
+    shape/dtype surface and — matching the pre-vma world — no `.vma`.
+    The single version shim every vma-aware call site routes through."""
+    fn = getattr(jax, "typeof", None)
+    if fn is not None:
+        return fn(x)
+    from jax.core import get_aval
+    return get_aval(x)
+
+
+def vma(x) -> frozenset:
+    """The varying-manual-axes set of `x` under shard_map; empty on jax
+    versions without vma tracking (0.4.x), where replication checking
+    is the coarser whole-value `check_rep`."""
+    return frozenset(getattr(typeof(x), "vma", None) or ())
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """Version shim over the moving shard_map surface: jax 0.4.x ships
+    it as `jax.experimental.shard_map.shard_map(check_rep=...)`, newer
+    jax as top-level `jax.shard_map(check_vma=...)`. Callers use the
+    modern spelling; the shim maps the replication-check kwarg to
+    whatever the installed jax accepts."""
+    import inspect
+    try:
+        from jax import shard_map as _sm
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+    params = inspect.signature(_sm).parameters
+    kw = {}
+    if "check_vma" in params:
+        kw["check_vma"] = check_vma
+    elif "check_rep" in params:
+        kw["check_rep"] = check_vma
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a named mesh axis from inside shard_map.
+    `lax.axis_size` postdates jax 0.4.x, where `core.axis_frame(name)`
+    returns the size directly (an int)."""
+    from jax import lax
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    from jax.core import axis_frame
+    fr = axis_frame(axis_name)
+    return fr if isinstance(fr, int) else fr.size
+
+
 def mark_varying(x, axis_name: str | None = None, *, like=None):
     """Mark a value as varying over mesh axes (shard_map per-device type
     tracking). Shim over the in-flux pcast/pvary jax API — the single
     definition used by ring attention and the pipeline schedule.
-    Idempotent: axes x already varies over are skipped.
+    Idempotent: axes x already varies over are skipped. On jax versions
+    without vma tracking (0.4.x: no pcast/pvary, avals carry no .vma)
+    this is a no-op — there is no per-axis type to adjust.
 
     like: instead of naming an axis, copy the varying-axis set of another
     value — scan carries built from jnp.zeros/full must match the vma of
@@ -51,16 +105,17 @@ def mark_varying(x, axis_name: str | None = None, *, like=None):
     shard_map spans (e.g. 'data' x 'model' in a DPxSP step)."""
     from jax import lax
     if like is not None:
-        axes = tuple(getattr(jax.typeof(like), "vma", ()))
+        axes = tuple(vma(like))
     else:
         axes = (axis_name,)
-    cur = frozenset(getattr(jax.typeof(x), "vma", ()))
-    missing = tuple(a for a in axes if a and a not in cur)
+    missing = tuple(a for a in axes if a and a not in vma(x))
     if not missing:
         return x
     if hasattr(lax, "pcast"):
         return lax.pcast(x, missing, to="varying")
-    return lax.pvary(x, missing)
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, missing)
+    return x  # pre-vma jax: nothing to mark
 
 
 def init_distributed(coordinator: str | None = None, num_processes: int | None = None,

@@ -156,6 +156,23 @@ class Message:
         node = getattr(self, "_node", None)
         return node is not None and name in node
 
+    def clear(self, name: str) -> None:
+        """proto2-style ClearField: reset the field to its schema
+        default and drop source-text presence, so `has(name)` becomes
+        False. The CLI uses this to let a flag override a prototxt
+        value's PRESENCE, not just its value (e.g. -grad_bucket_mb
+        switching a recipe off its reduce_buckets sizing mode)."""
+        node = getattr(self, "_node", None)
+        if node is not None:
+            node.fields.pop(name, None)
+        for f in dataclasses.fields(self):
+            if f.name == name:
+                setattr(self, name,
+                        f.default_factory() if f.default_factory
+                        is not dataclasses.MISSING else f.default)
+                return
+        raise AttributeError(f"{type(self).__name__} has no field {name!r}")
+
 
 def _coerce_value(value: Any, target: Any, fname: str) -> Any:
     if isinstance(target, type) and issubclass(target, Message):
@@ -853,7 +870,13 @@ class NetParameter(Message):
     # fp16 loss scaling (caffe.proto:130; applied net.cpp:815-818)
     global_grad_scale: float = 1.0
     default_conv_algos_override: str = ""
-    reduce_buckets: int = 6  # accepted; XLA schedules collectives instead
+    # gradient-reduction bucket count (caffe.proto:140, consumed by
+    # net.cpp:824-863). Default bucket count for the overlapped bucketed
+    # reduction plane (ISSUE 6, parallel/reduction.py) when the solver
+    # does not override it; the default GSPMD path still lets XLA place
+    # the collectives. 0/negative is rejected at Solver init — this knob
+    # is no longer accept-and-ignore.
+    reduce_buckets: int = 6
 
 
 # ---------------------------------------------------------------------------
@@ -985,6 +1008,28 @@ class SolverParameter(Message):
     #   abort     — treat divergence as fatal: no restart, exit 88
     anomaly_action: str = "rewind"
     anomaly_lr_mult: float = 0.1
+    # TPU-native extension (ISSUE 6, overlapped bucketed gradient
+    # reduction — parallel/reduction.py, the reference ReduceAndUpdate
+    # plane net.cpp:757-913): when true, the data-parallel train step
+    # computes gradients per device under shard_map and reduces them
+    # with ONE lax.psum per contiguous bucket (reverse topological
+    # layer order — the order backward produces them), so the TPU
+    # scheduler can hoist each bucket's collective over the remaining
+    # backward. false (default) = GSPMD-implicit reduction, today's
+    # behavior; nets the per-device backward cannot express bitwise
+    # (BatchNorm/MoE/host-callback/data-dependent loss normalization)
+    # fall back to implicit with a warning.
+    reduce_overlap: bool = False
+    # bucket count for the overlapped reduction: 0 (default) inherits
+    # the net-level reduce_buckets (reference default 6); explicit
+    # 0/negative values are rejected. Ignored when grad_bucket_mb sets
+    # a byte budget instead.
+    reduce_buckets: int = 0
+    # alternative bucket sizing: pack buckets up to this many MiB of
+    # gradient bytes (a single larger param gets its own bucket, with a
+    # warning). 0 (default) = use the bucket count. Negative rejected;
+    # setting both this and reduce_buckets is an error.
+    grad_bucket_mb: float = 0.0
     # TPU-native extension (ISSUE 3): dispatch watchdog deadline in
     # seconds. >0 arms a monitor thread that journals the run state and
     # hard-exits (exit code 86) when any device dispatch/harvest blocks

@@ -77,6 +77,32 @@ def _parser() -> argparse.ArgumentParser:
                    "(overrides solver test_chunk; 0 = prototxt value, "
                    "which defaults to auto-sizing T from the eval "
                    "super-batch HBM budget)")
+    # overlapped bucketed reduction flags (ISSUE 6, parallel/reduction.py)
+    p.add_argument("-reduce_overlap", "--reduce-overlap",
+                   dest="reduce_overlap", action="store_true",
+                   help="explicit overlapped bucketed gradient "
+                   "reduction: the data-parallel step computes grads "
+                   "per device (shard_map) and psums them one bucket "
+                   "at a time in reverse layer order, so the TPU "
+                   "scheduler overlaps each bucket's collective with "
+                   "the remaining backward (enables solver "
+                   "reduce_overlap; requires -gpu all or -mesh; "
+                   "incompatible nets fall back to the implicit "
+                   "GSPMD reduction with a warning)")
+    p.add_argument("-reduce_buckets", "--reduce-buckets",
+                   dest="reduce_buckets", type=int, default=0,
+                   help="gradient buckets for -reduce_overlap "
+                   "(overrides solver reduce_buckets; 0 = prototxt "
+                   "value, which defaults to the net-level "
+                   "reduce_buckets, reference default 6); 0/negative "
+                   "explicit values are rejected")
+    p.add_argument("-grad_bucket_mb", "--grad-bucket-mb",
+                   dest="grad_bucket_mb", type=float, default=0.0,
+                   help="size -reduce_overlap buckets by a MiB budget "
+                   "instead of a count (overrides solver "
+                   "grad_bucket_mb; a single param above the budget "
+                   "gets its own bucket with a warning; exclusive of "
+                   "-reduce_buckets)")
     # survivable-training flags (ISSUE 3, utils/resilience.py)
     p.add_argument("-resume", "--resume", default="",
                    help="'auto' = resume from the newest VERIFIED "
@@ -295,6 +321,29 @@ def cmd_train(args) -> int:
         sp.snapshot_keep = args.snapshot_keep
     if args.watchdog_deadline:
         sp.watchdog_deadline = args.watchdog_deadline
+    if args.reduce_overlap:
+        sp.reduce_overlap = True
+    # a CLI sizing mode overrides the prototxt's OTHER mode too (a
+    # recipe with `reduce_buckets: 4` can be re-run under a CLI byte
+    # budget without editing it); both CLI flags at once still reach
+    # the solver's "not both" validation and fail loudly
+    if args.reduce_buckets:
+        sp.reduce_buckets = args.reduce_buckets
+        if not args.grad_bucket_mb:
+            sp.clear("grad_bucket_mb")
+    if args.grad_bucket_mb:
+        sp.grad_bucket_mb = args.grad_bucket_mb
+        if not args.reduce_buckets:
+            sp.clear("reduce_buckets")
+    if getattr(sp, "reduce_overlap", False):
+        # libtpu scheduling flags for collective/compute overlap —
+        # LIBTPU_INIT_ARGS is read only by libtpu, so this is a no-op
+        # on CPU runs; must land before the first jax computation
+        # initializes the backend (reduction.tpu_overlap_flags)
+        from ..parallel import reduction
+        if reduction.apply_tpu_overlap_flags(os.environ):
+            log.info("TPU overlap flags appended to LIBTPU_INIT_ARGS: %s",
+                     " ".join(reduction.tpu_overlap_flags()))
     if args.train_guard:
         sp.train_guard = True
     if args.guard_max_skips >= 0:

@@ -202,7 +202,7 @@ def register(cls: type[LintPass]) -> type[LintPass]:
 def _load_passes() -> None:
     # import for side effect: each module registers its pass(es)
     from . import (concrete_init, doc_drift, gated_imports,  # noqa: F401
-                   host_sync, reference_citation, traced_flow)
+                   host_sync, knob_drift, reference_citation, traced_flow)
 
 
 # ---------------------------------------------------------------------------

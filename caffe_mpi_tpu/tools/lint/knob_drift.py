@@ -1,0 +1,175 @@
+"""knob-drift pass — performance knobs must be consumed, flagged, and
+documented (the accepted-but-ignored detector).
+
+ISSUE 6's trigger: `reduce_buckets` sat in the config schema for five
+PRs as accepted-and-ignored (proto/config.py — the reference consumes
+it in net.cpp:757-913, we silently didn't). A knob that parses but
+drives nothing is worse than a missing one: recipes carry it, operators
+tune it, and nothing changes. This pass holds every registered
+performance knob to four legs at once:
+
+  1. declared:  a `SolverParameter` dataclass field in
+                caffe_mpi_tpu/proto/config.py (read by AST, no import)
+  2. flagged:   spelled in caffe_mpi_tpu/tools/cli.py (the `caffe
+                train` surface — a knob users cannot reach from the
+                CLI is a solver-internal, not a knob)
+  3. documented: named in docs/benchmarks.md (the perf-knob runbook)
+  4. consumed:  READ somewhere under caffe_mpi_tpu/ or bench.py
+                outside the schema, the CLI plumbing, and this lint
+                package itself — a Load-context attribute access
+                `.knob` or a `"knob"` string literal passed as a call
+                argument (getattr / has checks). Writes (`sp.knob =
+                args.knob` is plumbing, not consumption), docstring
+                mentions, and this registry's own KNOBS tuple do NOT
+                count. This is the leg whose absence means
+                accept-and-ignore.
+
+Like doc-drift, this is a whole-tree pass rooted at the run root;
+roots without the schema/CLI/docs triple (fixture dirs) produce no
+findings. Waive a leg on the knob's registry line below with
+`# lint: ok(knob-drift) — reason` (e.g. a knob staged one PR before
+its consumer).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterator
+
+from . import FileContext, Finding, LintPass, iter_py_files, register
+
+# the knob registry: solver-level execution-schedule/perf knobs, each
+# required to satisfy all four legs. Extend this tuple when adding a
+# knob (the docs/benchmarks.md section for it is then enforced too).
+KNOBS = (
+    "step_chunk",       # ISSUE 1: K-step fused training
+    "test_chunk",       # ISSUE 2: fused async evaluation
+    "reduce_overlap",   # ISSUE 6: overlapped bucketed reduction
+    "reduce_buckets",   # ISSUE 6: bucket count
+    "grad_bucket_mb",   # ISSUE 6: bucket byte budget
+)
+
+CONFIG_FILE = os.path.join("caffe_mpi_tpu", "proto", "config.py")
+CLI_FILE = os.path.join("caffe_mpi_tpu", "tools", "cli.py")
+DOCS_FILE = os.path.join("docs", "benchmarks.md")
+# where a consumer read counts (schema + CLI plumbing excluded: writing
+# `sp.knob = args.knob` is not consumption; the lint package excluded:
+# its own KNOBS registry naming every knob must not satisfy the leg it
+# enforces)
+CONSUMER_SCAN = ("caffe_mpi_tpu", "bench.py")
+_EXCLUDED_CONSUMERS = (CONFIG_FILE, CLI_FILE)
+_EXCLUDED_CONSUMER_DIRS = (os.path.join("caffe_mpi_tpu", "tools", "lint"),)
+
+
+def _solver_fields(path: str) -> dict[str, int]:
+    """{field_name: line} of SolverParameter's dataclass fields (and
+    NetParameter's, whose net-level knobs count as declarations too),
+    by AST — the pass must run without the package importable."""
+    tree = ast.parse(open(path, encoding="utf-8").read(), filename=path)
+    fields: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name in (
+                "SolverParameter", "NetParameter"):
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                        stmt.target, ast.Name):
+                    fields.setdefault(stmt.target.id, stmt.lineno)
+    return fields
+
+
+def _mentions(src: str, knob: str) -> bool:
+    return knob in src
+
+
+def _consumes(tree: ast.Module | None, knob: str) -> bool:
+    """True when the AST READS the knob: a Load-context `x.knob`
+    attribute access, or a `"knob"` string literal passed as a call
+    argument (getattr(sp, "knob"), sp.has("knob")). A Store/Del-context
+    attribute (`sp.knob = args.knob` — plumbing) and a bare string
+    outside a call (docstrings, registry tuples) do not count."""
+    if tree is None:
+        return False
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr == knob \
+                and isinstance(node.ctx, ast.Load):
+            return True
+        if isinstance(node, ast.Call):
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            if any(isinstance(a, ast.Constant) and a.value == knob
+                   for a in args):
+                return True
+    return False
+
+
+@register
+class KnobDriftPass(LintPass):
+    name = "knob-drift"
+    description = ("perf knobs (step_chunk/test_chunk/reduce_*) must be "
+                   "declared, CLI-flagged, documented, and CONSUMED — "
+                   "no accept-and-ignore")
+
+    def check_tree(self, ctxs: list[FileContext],
+                   root: str) -> Iterator[Finding]:
+        cfg_path = os.path.join(root, CONFIG_FILE)
+        cli_path = os.path.join(root, CLI_FILE)
+        docs_path = os.path.join(root, DOCS_FILE)
+        if not (os.path.isfile(cfg_path) and os.path.isfile(cli_path)
+                and os.path.isfile(docs_path)):
+            return
+        fields = _solver_fields(cfg_path)
+        cli_src = open(cli_path, encoding="utf-8").read()
+        docs_src = open(docs_path, encoding="utf-8").read()
+
+        # consumer scan: whole production tree, reusing parsed ctxs
+        by_path = {c.path: c for c in ctxs}
+        consumed: set[str] = set()
+        for target in CONSUMER_SCAN:
+            path = os.path.join(root, target)
+            if not os.path.exists(path):
+                continue
+            for fp in iter_py_files([path]):
+                rel = os.path.relpath(fp, root)
+                if rel in _EXCLUDED_CONSUMERS or any(
+                        rel == d or rel.startswith(d + os.sep)
+                        for d in _EXCLUDED_CONSUMER_DIRS):
+                    continue
+                ctx = by_path.get(os.path.abspath(fp))
+                if ctx is not None:
+                    tree = ctx.tree
+                else:
+                    try:
+                        tree = ast.parse(
+                            open(fp, encoding="utf-8").read())
+                    except SyntaxError:
+                        continue
+                for knob in KNOBS:
+                    if knob not in consumed and _consumes(tree, knob):
+                        consumed.add(knob)
+
+        cfg_ctx = by_path.get(os.path.abspath(cfg_path))
+        waivers = cfg_ctx.waivers if cfg_ctx is not None else {}
+        for knob in KNOBS:
+            line = fields.get(knob, 1)
+
+            def waived() -> bool:
+                return self.name in waivers.get(line, ()) or \
+                    self.name in waivers.get(line - 1, ())
+
+            missing = []
+            if knob not in fields:
+                missing.append("a SolverParameter field in "
+                               + CONFIG_FILE)
+            if not _mentions(cli_src, knob):
+                missing.append("a CLI flag in " + CLI_FILE)
+            if not _mentions(docs_src, knob):
+                missing.append("documentation in " + DOCS_FILE)
+            if knob not in consumed:
+                missing.append(
+                    "a consumer read under caffe_mpi_tpu/ — the knob "
+                    "is accepted but IGNORED")
+            if missing and not waived():
+                yield Finding(
+                    self.name, cfg_path, line,
+                    f"knob {knob!r} is missing " + "; ".join(missing),
+                    span=None)

@@ -20,7 +20,8 @@ from caffe_mpi_tpu.tools import lint
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 ALL_PASSES = ("host-sync", "traced-control-flow", "concrete-init",
-              "gated-imports", "reference-citation", "doc-drift")
+              "gated-imports", "reference-citation", "doc-drift",
+              "knob-drift")
 
 
 def _write(tmp_path, name, src):
@@ -440,6 +441,98 @@ def test_doc_drift_clean_tree_is_clean(tmp_path):
     root = _mini_tree(tmp_path)
     assert _run([os.path.join(root, "caffe_mpi_tpu")],
                 ["doc-drift"], root=root) == []
+
+
+# ---------------------------------------------------------------------------
+# knob-drift (ISSUE 6): accepted-but-ignored perf knobs must fail
+
+def _knob_tree(tmp_path, *, consume_all=True):
+    """Minimal root satisfying all four legs for every registered knob;
+    consume_all=False drops reduce_buckets' consumer (the seeded
+    accept-and-ignore bug this pass exists to catch)."""
+    from caffe_mpi_tpu.tools.lint.knob_drift import KNOBS
+    fields = "\n".join(f"    {k}: int = 0" for k in KNOBS)
+    _write(tmp_path, "caffe_mpi_tpu/proto/config.py",
+           f"class SolverParameter:\n{fields}\n")
+    _write(tmp_path, "caffe_mpi_tpu/tools/cli.py",
+           "FLAGS = " + repr(list(KNOBS)) + "\n")
+    _write(tmp_path, "docs/benchmarks.md",
+           " ".join(f"`{k}`" for k in KNOBS) + "\n")
+    reads = [k for k in KNOBS
+             if consume_all or k != "reduce_buckets"]
+    _write(tmp_path, "caffe_mpi_tpu/solver.py",
+           "def f(sp):\n" + "".join(f"    sp.{k}\n" for k in reads)
+           + "    return sp\n")
+    return str(tmp_path)
+
+
+def test_knob_drift_clean_tree_is_clean(tmp_path):
+    root = _knob_tree(tmp_path)
+    assert _run([os.path.join(root, "caffe_mpi_tpu")],
+                ["knob-drift"], root=root) == []
+
+
+def test_knob_drift_catches_accepted_but_ignored(tmp_path):
+    root = _knob_tree(tmp_path, consume_all=False)
+    findings = _run([os.path.join(root, "caffe_mpi_tpu")],
+                    ["knob-drift"], root=root)
+    assert len(findings) == 1
+    assert "reduce_buckets" in findings[0].message
+    assert "IGNORED" in findings[0].message
+
+
+def test_knob_drift_honors_waiver(tmp_path):
+    # the waiver sits on the field's line in the schema — the knob's
+    # one stable anchor (fields here are emitted one per line, so the
+    # trailing comment lands on the last field's line; waive ALL by
+    # putting it above the class instead would hide real findings)
+    from caffe_mpi_tpu.tools.lint.knob_drift import KNOBS
+    root = _knob_tree(tmp_path, consume_all=False)
+    cfg = os.path.join(root, "caffe_mpi_tpu/proto/config.py")
+    src = open(cfg).read().replace(
+        "    reduce_buckets: int = 0",
+        "    reduce_buckets: int = 0  "
+        "# lint: ok(knob-drift) — consumer lands next PR")
+    open(cfg, "w").write(src)
+    assert _run([os.path.join(root, "caffe_mpi_tpu")],
+                ["knob-drift"], root=root) == []
+    assert len(KNOBS) >= 5  # the ISSUE-6 knobs are registered
+
+
+def test_knob_drift_write_is_not_consumption(tmp_path):
+    # bench/CLI-style plumbing `sp.knob = v` is a Store-context
+    # attribute — it must NOT satisfy the consumed leg, or deleting
+    # every real reader would still ship lint-clean
+    root = _knob_tree(tmp_path, consume_all=False)
+    _write(tmp_path, "caffe_mpi_tpu/plumbing.py",
+           "def f(sp, v):\n    sp.reduce_buckets = v\n")
+    findings = _run([os.path.join(root, "caffe_mpi_tpu")],
+                    ["knob-drift"], root=root)
+    assert len(findings) == 1
+    assert "reduce_buckets" in findings[0].message
+
+
+def test_knob_drift_registry_and_docstrings_are_not_consumption(tmp_path):
+    # the pass's own KNOBS tuple (anything under tools/lint/) and bare
+    # docstring mentions must not neuter the consumed leg — only a
+    # Load-context read or a call-argument string counts
+    root = _knob_tree(tmp_path, consume_all=False)
+    _write(tmp_path, "caffe_mpi_tpu/tools/lint/registry.py",
+           "KNOBS = ('reduce_buckets',)\n")
+    _write(tmp_path, "caffe_mpi_tpu/docmention.py",
+           '"""module that merely talks about reduce_buckets"""\n')
+    findings = _run([os.path.join(root, "caffe_mpi_tpu")],
+                    ["knob-drift"], root=root)
+    assert len(findings) == 1
+    assert "reduce_buckets" in findings[0].message
+
+
+def test_knob_drift_getattr_string_is_consumption(tmp_path):
+    root = _knob_tree(tmp_path, consume_all=False)
+    _write(tmp_path, "caffe_mpi_tpu/reader.py",
+           "def f(sp):\n    return getattr(sp, 'reduce_buckets', 0)\n")
+    assert _run([os.path.join(root, "caffe_mpi_tpu")],
+                ["knob-drift"], root=root) == []
 
 
 def test_doc_drift_waiver_honored_on_empty_path_selection(tmp_path):

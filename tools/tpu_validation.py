@@ -136,6 +136,21 @@ for causal in (False, True):
                  "-synthetic", "-max_iter", "200", "-gpu", "all",
                  "-snapshot_prefix", "/tmp/caffe_tpu_val/lenet"],
                 600, log)
+            # overlapped bucketed reduction surface on real hardware
+            # (ISSUE 6, parallel/reduction.py): exercises the CLI
+            # flags + the libtpu latency-hiding/async-collective flags
+            # (LIBTPU_INIT_ARGS — this is the only stage where a libtpu
+            # build could reject them). On this single-chip setup the
+            # solver logs the n=1 fallback and trains implicitly;
+            # engaging the bucketed shard_map program on hardware needs
+            # a multi-chip slice a future round may have.
+            run("train-gpu-all-reduce-overlap",
+                [py, "-m", "caffe_mpi_tpu.tools.cli", "train",
+                 "-solver", "models/lenet/lenet_solver.prototxt",
+                 "-synthetic", "-max_iter", "100", "-gpu", "all",
+                 "-reduce_overlap", "-reduce_buckets", "4",
+                 "-snapshot_prefix", "/tmp/caffe_tpu_val/lenet_overlap"],
+                600, log)
             # survivable training on real hardware (ISSUE 3): the fault
             # plane kills the child at iter 60; the supervisor must
             # restart it with --resume auto onto the newest VERIFIED

@@ -58,6 +58,22 @@ def resize_image(im: np.ndarray, new_dims, interp_order: int = 1) -> np.ndarray:
     return np.stack(chans, axis=2)
 
 
+def resize_center_crop(im: np.ndarray, image_dims, crop_dims) -> np.ndarray:
+    """Resize HWC image to image_dims, center-crop to crop_dims — the
+    Classifier.predict(oversample=False) geometry, shared with the
+    serving engine so the row-parity contract cannot drift. Same-size
+    inputs skip the PIL resize (hot-path cost, numerically identity)."""
+    image_dims = np.asarray(image_dims)
+    crop_dims = np.asarray(crop_dims)
+    if tuple(im.shape[:2]) != tuple(int(d) for d in image_dims):
+        im = resize_image(im, image_dims)
+    if not np.array_equal(image_dims, crop_dims):
+        center = ((image_dims - crop_dims) // 2).astype(int)
+        im = im[center[0]:center[0] + int(crop_dims[0]),
+                center[1]:center[1] + int(crop_dims[1]), :]
+    return im
+
+
 def oversample(images, crop_dims) -> np.ndarray:
     """10-crop augmentation: 4 corners + center, mirrored
     (reference io.py oversample)."""
@@ -143,6 +159,26 @@ class Transformer:
         if in_ in self.input_scale:
             out = out * self.input_scale[in_]
         return out
+
+    @classmethod
+    def for_input(cls, in_: str, shape: tuple, *, transpose=(2, 0, 1),
+                  mean=None, input_scale=None, raw_scale=None,
+                  channel_swap=None) -> "Transformer":
+        """One-input transformer with the pycaffe Classifier defaults —
+        the single setup recipe shared by classifier.py and the serving
+        engine, so the two preprocessing surfaces cannot drift."""
+        t = cls({in_: shape})
+        if transpose is not None:
+            t.set_transpose(in_, transpose)
+        if mean is not None:
+            t.set_mean(in_, mean)
+        if input_scale is not None:
+            t.set_input_scale(in_, input_scale)
+        if raw_scale is not None:
+            t.set_raw_scale(in_, raw_scale)
+        if channel_swap is not None:
+            t.set_channel_swap(in_, channel_swap)
+        return t
 
     def deprocess(self, in_: str, data: np.ndarray) -> np.ndarray:
         self._check(in_)

@@ -2,7 +2,13 @@
 
 Reference: python/caffe/classifier.py (center-crop or oversampled
 classification) and python/caffe/detector.py (R-CNN style window
-detection with context padding). Both sit on the pycaffe Net + Transformer.
+detection with context padding). Both sit on the pycaffe Net +
+Transformer; since ISSUE 7 the batched forward itself is the serving
+engine's padded-bucket path (serving/engine.py BucketedForward) — the
+same compiled programs the production serving plane runs — instead of a
+private pad-to-declared-batch loop. Scores are row-identical: inference
+rows are batch-independent (conv/ip/softmax are per-row, BatchNorm uses
+running stats), and the tail chunk is padded either way.
 """
 
 from __future__ import annotations
@@ -14,27 +20,67 @@ from .pycaffe import Net
 
 
 class _PreprocessingNet(Net):
-    """Shared transformer setup + padded static-batch forward loop."""
+    """Shared transformer setup + the engine's padded-bucket forward."""
 
     def __init__(self, model_file: str, pretrained_file: str, mean=None,
                  input_scale=None, raw_scale=None, channel_swap=None):
         super().__init__(model_file, pretrained_file, "TEST")
         in_ = self.inputs[0]
         shape = self._net.blob_shapes[in_]
-        self.transformer = caffe_io.Transformer({in_: shape})
-        self.transformer.set_transpose(in_, (2, 0, 1))
-        if mean is not None:
-            self.transformer.set_mean(in_, mean)
-        if input_scale is not None:
-            self.transformer.set_input_scale(in_, input_scale)
-        if raw_scale is not None:
-            self.transformer.set_raw_scale(in_, raw_scale)
-        if channel_swap is not None:
-            self.transformer.set_channel_swap(in_, channel_swap)
+        self.transformer = caffe_io.Transformer.for_input(
+            in_, shape, mean=mean, input_scale=input_scale,
+            raw_scale=raw_scale, channel_swap=channel_swap)
+        self._bucket_fwd = None
 
     def _forward_batched(self, crops) -> np.ndarray:
-        """Preprocess + forward a list of HWC crops through the net's static
-        batch, padding the tail chunk; returns scores from the last output."""
+        """Preprocess + forward a list of HWC crops through the serving
+        engine's bucket ladder (max bucket = the net's declared batch),
+        padding the tail chunk; returns scores from the last output.
+        Preprocessing stays per-chunk so peak memory is one max-bucket
+        array, not the whole crop set (R-CNN window sets run to
+        thousands of crops). The compiled bucket programs take params
+        as arguments, so copy_from()/params assignment needs no cache
+        invalidation."""
+        from .serving.engine import BucketedForward
+        if not len(crops):
+            raise ValueError("no crops to forward (empty input)")
+        in_ = self.inputs[0]
+        if self._bucket_fwd is None:
+            try:
+                fwd = BucketedForward(
+                    self._net.param, out_blob=self.outputs[-1],
+                    max_batch=self._net.blob_shapes[in_][0],
+                    model_dir=self._net.model_dir, full_env=True)
+                # multi-input nets raise HERE, not in the constructor —
+                # probe before committing so they fall back too
+                fwd.input_blob()
+                self._bucket_fwd = fwd
+            except ValueError:
+                # deploy nets BucketedForward cannot ladder — fed by
+                # non-Input layers (MemoryData, HDF5Data, ...: no
+                # rewritable Input batch dim) or with multiple inputs
+                # (pycaffe zero-fills the unfed ones) — keep the
+                # classic declared-batch loop
+                self._bucket_fwd = False
+        if self._bucket_fwd is False:
+            return self._forward_classic(crops)
+        fwd = self._bucket_fwd
+        preds = []
+        for start in range(0, len(crops), fwd.max_batch):
+            data = np.stack([self.transformer.preprocess(in_, c)
+                             for c in crops[start:start + fwd.max_batch]])
+            preds.append(fwd.forward(self._params, self._state, data))
+        # pycaffe parity: the old loop went through Net.forward, which
+        # exposes every blob of the last executed batch via net.blobs —
+        # keep that contract (values at the final BUCKET's batch size)
+        # lint: ok(host-sync) — one harvest per predict, the pycaffe API
+        self._blob_values = {k: np.array(v)
+                             for k, v in fwd.last_env.items()}
+        return np.concatenate(preds)
+
+    def _forward_classic(self, crops) -> np.ndarray:
+        """Pad-to-declared-batch loop through Net.forward — the
+        pre-bucket path, kept for nets BucketedForward cannot ladder."""
         in_ = self.inputs[0]
         batch_size = self._net.blob_shapes[in_][0]
         out_blob = self.outputs[-1]
@@ -63,17 +109,16 @@ class Classifier(_PreprocessingNet):
             else self.crop_dims
 
     def predict(self, inputs, oversample: bool = True) -> np.ndarray:
-        resized = [caffe_io.resize_image(im, self.image_dims)
-                   for im in inputs]
         if oversample:
+            resized = [caffe_io.resize_image(im, self.image_dims)
+                       for im in inputs]
             crops = caffe_io.oversample(resized, self.crop_dims)
         else:
-            center = np.array([(self.image_dims[0] - self.crop_dims[0]) // 2,
-                               (self.image_dims[1] - self.crop_dims[1]) // 2])
+            # shared geometry with the serving engine (row parity)
             crops = np.stack([
-                im[center[0]:center[0] + self.crop_dims[0],
-                   center[1]:center[1] + self.crop_dims[1], :]
-                for im in resized])
+                caffe_io.resize_center_crop(im, self.image_dims,
+                                            self.crop_dims)
+                for im in inputs])
         preds = self._forward_batched(list(crops))
         if oversample:
             preds = preds.reshape(len(inputs), 10, -1).mean(axis=1)

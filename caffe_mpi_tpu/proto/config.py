@@ -1041,6 +1041,35 @@ class SolverParameter(Message):
     watchdog_deadline: float = 0.0
 
 
+# ---------------------------------------------------------------------------
+# ServingParameter (ISSUE 7 — no reference analogue: the reference's
+# deployment story is the Flask web demo + extract_features, both
+# configured ad hoc; here the serving plane's knobs are schema like
+# every other parameter surface so recipes can pin them)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ServingParameter(Message):
+    """Inference-serving configuration (caffe_mpi_tpu/serving/,
+    docs/serving.md). Parsed from a prototxt via the usual Message
+    machinery or built by the `caffe serve` CLI flags."""
+    # continuous-batching window in milliseconds: a batch closes when
+    # this long has passed since its FIRST request arrived, or earlier
+    # when a full max-size bucket is waiting. 0 = dispatch immediately
+    # (no batching beyond what is already queued).
+    serve_window_ms: float = 5.0
+    # explicit padded-batch bucket ladder, comma-separated ("1,4,16");
+    # every bucket is AOT-compiled at model load so arrival-size
+    # variance never recompiles. "" (default) = geometric 1,4,16,...
+    # up to the deploy prototxt's declared batch.
+    serve_buckets: str = ""
+    # HBM budget (MiB) for device-resident model weights across the
+    # zoo; exceeding it spills the least-recently-used model's params
+    # to the host master copy (compiled programs survive a spill).
+    # 0 (default) = unlimited, everything stays resident.
+    serve_hbm_mb: float = 0.0
+
+
 SOLVER_TYPE_NAMES = {
     # legacy solver_type enum value -> modern type string
     "SGD": "SGD", "NESTEROV": "Nesterov", "ADAGRAD": "AdaGrad",

@@ -1,0 +1,14 @@
+"""Production inference serving plane (ISSUE 7).
+
+Reference: the deployment story of python/caffe/classifier.py +
+examples/web_demo/app.py (feature embedding / classification as a
+service). See engine.py for the TPU-native design notes.
+"""
+
+from .engine import (BucketedForward, CompileCounter, InferenceModel,
+                     ServingEngine, bucket_for, plan_ladder)
+
+__all__ = [
+    "BucketedForward", "CompileCounter", "InferenceModel", "ServingEngine",
+    "bucket_for", "plan_ladder",
+]

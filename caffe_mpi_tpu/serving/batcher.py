@@ -1,0 +1,310 @@
+"""Continuous batching — request queue, batching window, async harvest.
+
+Reference: examples/web_demo/app.py serves one image per HTTP request
+through Classifier.predict — every arrival pays a full forward at the
+deploy batch, and the host blocks on the device for each one. The
+reference framework's own throughput story (tools/extract_features.cpp,
+python/caffe/classifier.py) is offline batching; it has no online
+batcher.
+
+TPU-native design: arrivals land in a queue; a single dispatcher thread
+closes a batch when either the batching window (measured from the
+batch's FIRST request) expires or a full max-size bucket is available,
+pads it to the smallest ladder bucket (engine.py — every bucket is an
+AOT-compiled program, so arrival-size variance never compiles), and
+dispatches WITHOUT waiting for the result: jax returns device futures,
+and a separate harvest thread materializes them out-of-band. Over the
+tunnel (~tens of ms per host<->device round trip) this is the
+DeviceFeedQueue recipe from training (data/feeder.py) applied to
+serving — the RTT of batch k overlaps the assembly of batch k+1, so
+sustained img/s approaches device throughput instead of
+1 / (RTT + compute).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_MAX_RECORDS = 10000  # telemetry ring: enough for p99 at serving rates
+
+
+@dataclass
+class _Request:
+    model: str
+    data: np.ndarray
+    t_enqueue: float
+    future: Future = field(default_factory=Future)
+
+
+class Batcher:
+    """One dispatcher thread + one harvest thread around the engine."""
+
+    def __init__(self, engine):
+        self._engine = engine
+        self._pending: deque[_Request] = deque()
+        # per-model pending counts (guarded by _cv): the window wait
+        # checks group-readiness on every submit notify, and a deque
+        # scan there is O(backlog) per arrival
+        self._pending_by_model: dict[str, int] = {}
+        self._cv = threading.Condition()
+        self._harvest_q: queue.Queue = queue.Queue()
+        self._records: deque[dict] = deque(maxlen=_MAX_RECORDS)
+        self._rec_lock = threading.Lock()
+        # (model, real_images, bucket) per dispatch, in dispatch order —
+        # capped like the latency ring (a serve_forever process would
+        # otherwise grow it for life); dispatch_count is the all-time total
+        self.dispatches: deque[tuple[str, int, int]] = deque(
+            maxlen=_MAX_RECORDS)
+        self.dispatch_count = 0
+        self._outstanding = 0
+        self._idle = threading.Event()
+        self._idle.set()
+        self._stop = False
+        self._threads: list[threading.Thread] = []
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> None:
+        if self._threads:
+            return
+        for name, target in (("serve-dispatch", self._dispatch_loop),
+                             ("serve-harvest", self._harvest_loop)):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def close(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        # order matters: join the DISPATCHER before the harvest sentinel,
+        # so an in-flight dispatch's item is enqueued ahead of None and
+        # its futures still resolve (Ctrl-C with a request in flight).
+        # 60 s covers the slow legitimate dispatches (spill re-upload,
+        # cold-bucket compile); a dispatcher alive past that is wedged
+        # in device code — warn and abandon rather than hang close()
+        for t in self._threads[:1]:
+            t.join(timeout=60)
+            if t.is_alive():
+                log.warning("serving: dispatcher still busy at close; "
+                            "in-flight futures may be abandoned")
+        self._harvest_q.put(None)
+        for t in self._threads[1:]:
+            t.join(timeout=10)
+        self._threads = []
+        # a dispatch that outlived the join enqueues AFTER the sentinel,
+        # into a queue nobody reads — fail those futures instead of
+        # leaving callers blocked on a PENDING result forever
+        while True:
+            try:
+                item = self._harvest_q.get_nowait()
+            except queue.Empty:
+                break
+            if item is None:
+                continue
+            self._engine.note_retire(item[1])
+            for r in item[0]:
+                self._resolve(r.future,
+                              exc=RuntimeError("serving engine closed"))
+            self._retire(len(item[0]))
+        with self._cv:
+            while self._pending:
+                self._pending.popleft().future.cancel()
+                self._outstanding -= 1
+            self._pending_by_model.clear()
+            if self._outstanding <= 0:
+                self._idle.set()  # cancelled requests never harvest
+
+    # -- submission -----------------------------------------------------
+    def submit(self, model: str, data: np.ndarray) -> Future:
+        req = _Request(model, data, time.perf_counter())
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("serving engine is closed")
+            if not self._threads:
+                self.start()
+            self._pending.append(req)
+            self._pending_by_model[model] = \
+                self._pending_by_model.get(model, 0) + 1
+            self._outstanding += 1
+            self._idle.clear()
+            self._cv.notify_all()
+        return req.future
+
+    def drain(self, timeout: float = 60.0) -> None:
+        """Block until every submitted request has been harvested."""
+        if not self._idle.wait(timeout):
+            raise TimeoutError(
+                f"serving drain: requests still in flight after {timeout}s")
+
+    # -- dispatcher -----------------------------------------------------
+    def _group_ready(self, model: str, max_bucket: int) -> bool:
+        return self._pending_by_model.get(model, 0) >= max_bucket
+
+    def _take_group(self, model: str, max_bucket: int) -> list[_Request]:
+        """Pop up to max_bucket head-of-line requests for `model`,
+        preserving the arrival order of every other model."""
+        group, keep = [], deque()
+        while self._pending and len(group) < max_bucket:
+            req = self._pending.popleft()
+            (group if req.model == model else keep).append(req)
+        keep.extend(self._pending)
+        self._pending = keep
+        if group:
+            left = self._pending_by_model.get(model, 0) - len(group)
+            if left > 0:
+                self._pending_by_model[model] = left
+            else:
+                self._pending_by_model.pop(model, None)
+        return group
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._pending and not self._stop:
+                    self._cv.wait()
+                if self._stop:
+                    return
+                head = self._pending[0]
+                model = self._engine.model(head.model)
+                max_bucket = model.fwd.ladder[-1]
+                # batching window: measured from the BATCH's first
+                # request; a full max bucket closes the window early
+                deadline = head.t_enqueue + self._engine.window_ms / 1e3
+                while not self._stop:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0 or \
+                            self._group_ready(head.model, max_bucket):
+                        break
+                    self._cv.wait(timeout=remaining)
+                if self._stop:
+                    return
+                group = self._take_group(head.model, max_bucket)
+            if group:
+                self._dispatch(group)
+
+    @staticmethod
+    def _resolve(future: Future, value=None, exc: Exception | None = None
+                 ) -> None:
+        """Resolve a request future, tolerating caller-side cancel():
+        a PENDING future always accepts cancel(), so an unconditional
+        set_result would raise InvalidStateError and kill this worker
+        thread for every later request."""
+        if future.set_running_or_notify_cancel():
+            if exc is not None:
+                future.set_exception(exc)
+            else:
+                future.set_result(value)
+
+    def _dispatch(self, group: list[_Request]) -> None:
+        name = group[0].model
+        try:
+            # re-resolve by name: a load_model() reload during the open
+            # batching window must dispatch on the CURRENT model, not a
+            # retired object (whose residency check could even spill
+            # the fresh model to re-upload dead weights)
+            model = self._engine.model(name)
+        except Exception as e:  # noqa: BLE001 — failures go to callers
+            for r in group:
+                self._resolve(r.future, exc=e)
+            self._retire(len(group))
+            return
+        # the group was sized by the ladder seen at window-open; a
+        # reload may have SHRUNK the max bucket, so chunk to the
+        # current one instead of padding a negative dimension
+        maxb = model.fwd.ladder[-1]
+        for start in range(0, len(group), maxb):
+            self._dispatch_one(model, group[start:start + maxb])
+
+    def _dispatch_one(self, model, group: list[_Request]) -> None:
+        from .engine import bucket_for
+        name = group[0].model
+        t0 = time.perf_counter()
+        noted = False
+        try:
+            batch = np.stack([r.data for r in group]).astype(
+                np.float32, copy=False)
+            bucket = bucket_for(len(group), model.fwd.ladder)
+            padded = model.fwd.pad(batch, bucket)
+            # residency check per dispatch: a spilled model re-uploads
+            # its weights here (LRU may evict another model's);
+            # mark_in_flight pins the model against spilling until the
+            # harvest retires the execution
+            params, state = self._engine._make_resident(
+                model, mark_in_flight=True)
+            noted = True
+            out = model.fwd.run_bucket(params, state, padded)
+        except Exception as e:  # noqa: BLE001 — failures go to callers
+            if noted:
+                self._engine.note_retire(model)
+            log.exception("serving: dispatch failed for model %r", name)
+            for r in group:
+                self._resolve(r.future, exc=e)
+            self._retire(len(group))
+            return
+        with self._rec_lock:  # stats() iterates this deque concurrently
+            self.dispatches.append((name, len(group), bucket))
+            self.dispatch_count += 1
+        # hand the DEVICE array to the harvester; this thread goes
+        # straight back to assembling the next batch
+        self._harvest_q.put((group, model, out, t0, time.perf_counter()))
+
+    # -- harvester ------------------------------------------------------
+    def _harvest_loop(self) -> None:
+        while True:
+            item = self._harvest_q.get()
+            if item is None:
+                return
+            group, model, out, t_dispatch, t_dispatched = item
+            try:
+                # the harvest thread exists to pay this device->host
+                # sync off the dispatch path
+                # lint: ok(host-sync) — out-of-band harvest is the design
+                scores = np.asarray(out)
+            except Exception as e:  # noqa: BLE001
+                self._engine.note_retire(model)
+                for r in group:
+                    self._resolve(r.future, exc=e)
+                self._retire(len(group))
+                continue
+            self._engine.note_retire(model)
+            t_done = time.perf_counter()
+            with self._rec_lock:
+                for r in group:
+                    self._records.append({
+                        "model": r.model,
+                        "t_enqueue": r.t_enqueue,
+                        "t_done": t_done,
+                        "queue_ms": (t_dispatch - r.t_enqueue) * 1e3,
+                        "infer_ms": (t_done - t_dispatch) * 1e3,
+                        "total_ms": (t_done - r.t_enqueue) * 1e3,
+                    })
+            # resolve OUTSIDE _rec_lock: set_result runs done-callbacks
+            # synchronously in this thread, and a callback reading
+            # stats()/records() would re-acquire the non-reentrant lock
+            for i, r in enumerate(group):
+                self._resolve(r.future, scores[i])
+            self._retire(len(group))
+
+    def _retire(self, n: int) -> None:
+        with self._cv:
+            self._outstanding -= n
+            if self._outstanding <= 0:
+                self._idle.set()
+
+    def records(self) -> list[dict]:
+        with self._rec_lock:
+            return list(self._records)
+
+    def dispatch_snapshot(self) -> list[tuple[str, int, int]]:
+        with self._rec_lock:
+            return list(self.dispatches)

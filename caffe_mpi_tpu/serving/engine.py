@@ -1,0 +1,613 @@
+"""Inference engine — AOT-compiled, device-resident model zoo.
+
+Reference: python/caffe/classifier.py + python/caffe/detector.py run
+batch inference by padding crops into the deploy net's single static
+batch, and examples/web_demo/app.py serves that loop over HTTP one
+request at a time; tools/extract_features.cpp is the reference's
+"embedding as a service" batch path. All of them pay a full forward at
+the prototxt's declared batch no matter how many images arrived, and
+the pycaffe surface re-materializes every blob on the host per call.
+
+TPU-native design: inference here is a *pure* path split out of the
+training substrate — a deploy NetParameter becomes params plus one
+jitted `apply` per **padded shape bucket** (a fixed ladder of batch
+sizes, e.g. 1/4/16/max), each AOT-compiled at model load
+(`jax.jit(...).lower(...).compile()`), so arrival-size variance never
+triggers a recompile: steady-state serving calls only pre-built XLA
+executables (`CompileCounter` is the CPU-visible proof). Params are
+pinned device-resident across requests (the tunnel costs ~tens of ms
+per host<->device round trip; re-uploading weights per request would
+dwarf compute), and multiple models stay resident under a configurable
+HBM budget with LRU spill to the host master copy — spilling drops the
+device arrays only, never the compiled executables, so a reload is one
+device_put, not a recompile.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+from .. import caffe_io
+from ..net import Net
+from ..proto.config import NetParameter, ServingParameter
+
+log = logging.getLogger(__name__)
+
+# default bucket ladder: geometric x4 growth from 1 up to the model's
+# max batch — small arrivals pay a small program, bursts fill max
+DEFAULT_LADDER_GROWTH = 4
+
+
+def plan_ladder(max_batch: int, spec=None) -> tuple[int, ...]:
+    """Plan the padded-batch bucket ladder for a model.
+
+    Returns ascending, deduplicated bucket sizes that always include
+    `max_batch` (the largest program is the burst path). `spec` pins the
+    ladder explicitly — a comma string ("1,4,16") or an iterable of
+    ints; entries above `max_batch` are clipped out (the model cannot
+    run them). None = geometric default 1, 4, 16, ... max_batch.
+    """
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    if spec is None:
+        sizes = []
+        b = 1
+        while b < max_batch:
+            sizes.append(b)
+            b *= DEFAULT_LADDER_GROWTH
+        sizes.append(max_batch)
+        return tuple(sizes)
+    if isinstance(spec, str):
+        parts = [p.strip() for p in spec.split(",") if p.strip()]
+        try:
+            spec = [int(p) for p in parts]
+        except ValueError:
+            raise ValueError(f"bad bucket ladder spec {spec!r}: expected "
+                             "comma-separated ints like '1,4,16'") from None
+    sizes = sorted(set(int(b) for b in spec))
+    if not sizes:
+        raise ValueError("empty bucket ladder spec")
+    if sizes[0] < 1:
+        raise ValueError(f"bucket sizes must be >= 1, got {sizes[0]}")
+    sizes = [b for b in sizes if b <= max_batch]
+    if not sizes or sizes[-1] != max_batch:
+        sizes.append(max_batch)
+    return tuple(sizes)
+
+
+def bucket_for(n: int, ladder: tuple[int, ...]) -> int:
+    """Smallest bucket holding n images (callers chunk at ladder[-1])."""
+    if n < 1:
+        raise ValueError(f"need at least one image, got {n}")
+    for b in ladder:
+        if b >= n:
+            return b
+    return ladder[-1]
+
+
+class CompileCounter:
+    """Counts XLA compiles the serving plane performs. Steady-state
+    serving must never move it past the warmed bucket count — the
+    zero-recompile claim is `count == warmed buckets`, asserted on CPU
+    (tests/test_serving.py) and reported by bench.py's serving block."""
+
+    def __init__(self):
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def bump(self) -> None:
+        with self._lock:
+            self.count += 1
+
+
+def _tree_bytes(tree) -> int:
+    import jax
+    return sum(a.size * a.dtype.itemsize
+               for a in jax.tree_util.tree_leaves(tree)
+               if hasattr(a, "dtype"))
+
+
+class BucketedForward:
+    """Padded static-batch forward over a bucket ladder.
+
+    One deploy NetParameter, one compiled XLA program per bucket size
+    (the Input batch dim rewritten per bucket; layer params are
+    shape-identical across buckets, so one params tree serves all).
+    Shared by the serving engine and by Classifier/Detector
+    (classifier.py) so both surfaces run the exact same programs.
+    """
+
+    def __init__(self, net_param: NetParameter, *, ladder=None,
+                 max_batch: int = 0, out_blob: str | None = None,
+                 model_dir: str = "", counter: CompileCounter | None = None,
+                 full_env: bool = False):
+        self._base = copy.deepcopy(net_param)
+        self._model_dir = model_dir
+        declared = self._declared_batch(self._base)
+        self.max_batch = max_batch or declared
+        self.ladder = plan_ladder(self.max_batch, ladder)
+        self.counter = counter or CompileCounter()
+        self._nets: dict[int, Net] = {}
+        self._compiled: dict[int, object] = {}
+        self._out_blob = out_blob
+        self._lock = threading.Lock()
+        # full_env: programs return the whole blob environment instead
+        # of just the output blob — the pycaffe surface (classifier.py)
+        # needs net.blobs populated after predict(); serving keeps the
+        # single-output programs
+        self._full_env = full_env
+        self.last_env = None  # most recent bucket's env (full_env only)
+
+    @staticmethod
+    def _declared_batch(param: NetParameter) -> int:
+        from ..proto.upgrade import normalize_net
+        param = normalize_net(copy.deepcopy(param))
+        for lp in param.layer:
+            if lp.type == "Input" and lp.input_param and lp.input_param.shape:
+                dims = lp.input_param.shape[0].dim
+                if dims:
+                    return int(dims[0])
+        raise ValueError("deploy net has no Input layer with a declared "
+                         "shape; serving needs a deploy prototxt")
+
+    def _net_for(self, bucket: int) -> Net:
+        net = self._nets.get(bucket)
+        if net is None:
+            param = copy.deepcopy(self._base)
+            from ..proto.upgrade import normalize_net
+            param = normalize_net(param)
+            for lp in param.layer:
+                if lp.type == "Input" and lp.input_param:
+                    for shape in lp.input_param.shape:
+                        if shape.dim:
+                            shape.dim[0] = bucket
+            net = Net(param, phase="TEST", model_dir=self._model_dir,
+                      device_transform=False)
+            if len(net.feed_blobs) != 1:
+                raise ValueError(
+                    f"serving needs exactly one input blob, deploy net "
+                    f"declares {net.feed_blobs}")
+            self._nets[bucket] = net
+        return net
+
+    def init(self, seed: int = 0):
+        """Fresh (params, state) for this architecture — bucket-size
+        independent, so any bucket net can mint them."""
+        import jax
+        net = self._net_for(self.ladder[0])
+        return net.init(jax.random.PRNGKey(seed))
+
+    def out_blob(self, bucket: int | None = None) -> str:
+        if self._out_blob is None:
+            net = self._net_for(bucket or self.ladder[0])
+            consumed = {b for l in net.layers for b in l.lp.bottom}
+            outs = [t for l in net.layers for t in l.lp.top
+                    if t not in consumed]
+            self._out_blob = outs[-1]
+        return self._out_blob
+
+    def input_blob(self) -> str:
+        return self._net_for(self.ladder[0]).feed_blobs[0]
+
+    def input_shape(self, bucket: int | None = None) -> tuple:
+        net = self._net_for(bucket or self.ladder[0])
+        return net.blob_shapes[net.feed_blobs[0]]
+
+    def compile_bucket(self, bucket: int, params, state):
+        """AOT-compile this bucket's program (counted). Idempotent."""
+        import jax
+        with self._lock:
+            compiled = self._compiled.get(bucket)
+            if compiled is not None:
+                return compiled
+            net = self._net_for(bucket)
+            in_blob, out = net.feed_blobs[0], self.out_blob(bucket)
+
+            def fwd(p, s, feeds):
+                env, _, _ = net.apply(p, s, feeds, train=False)
+                return dict(env) if self._full_env else env[out]
+
+            feeds_struct = {in_blob: jax.ShapeDtypeStruct(
+                net.blob_shapes[in_blob], np.float32)}
+            compiled = jax.jit(fwd).lower(params, state,
+                                          feeds_struct).compile()
+            self.counter.bump()
+            self._compiled[bucket] = compiled
+            return compiled
+
+    def warm(self, params, state) -> int:
+        """Compile every ladder bucket ahead of traffic; returns the
+        number of warmed programs (== len(ladder))."""
+        for b in self.ladder:
+            self.compile_bucket(b, params, state)
+        return len(self.ladder)
+
+    def run_bucket(self, params, state, batch: np.ndarray):
+        """Dispatch one padded bucket; returns the DEVICE output array
+        (not harvested — the caller overlaps np.asarray with the next
+        batch's assembly). batch.shape[0] must be a ladder bucket."""
+        bucket = int(batch.shape[0])
+        compiled = self._compiled.get(bucket)
+        if compiled is None:
+            # cold path: only reachable when warm() was skipped — counted,
+            # so the zero-recompile assertion catches any steady-state use
+            compiled = self.compile_bucket(bucket, params, state)
+        in_blob = self.input_blob()
+        return compiled(params, state, {in_blob: batch})
+
+    @staticmethod
+    def pad(chunk: np.ndarray, bucket: int) -> np.ndarray:
+        if len(chunk) == bucket:
+            return chunk
+        pad = np.zeros((bucket - len(chunk), *chunk.shape[1:]), chunk.dtype)
+        return np.concatenate([chunk, pad])
+
+    def forward(self, params, state, data: np.ndarray) -> np.ndarray:
+        """Synchronous padded-bucket forward over N preprocessed images:
+        greedy max-bucket chunks, the tail rounded up to its smallest
+        bucket. Row-identical to the classic pad-to-declared-batch loop
+        (rows are batch-independent at inference: conv/ip/softmax are
+        per-row, BatchNorm uses running stats)."""
+        data = np.asarray(data, np.float32)
+        preds = []
+        start = 0
+        while start < len(data):
+            take = min(len(data) - start, self.ladder[-1])
+            chunk = data[start:start + take]
+            padded = self.pad(chunk, bucket_for(take, self.ladder))
+            out = self.run_bucket(params, state, padded)
+            if self._full_env:
+                self.last_env = out
+                out = out[self.out_blob()]
+            # the synchronous surface harvests one bucket per chunk by
+            # contract; async callers use run_bucket + the harvest thread
+            # lint: ok(host-sync) — deliberate per-bucket harvest
+            preds.append(np.asarray(out)[:take])
+            start += take
+        return np.concatenate(preds)
+
+
+class InferenceModel:
+    """One servable model: deploy prototxt -> host master weights +
+    bucketed AOT programs + preprocessing (classifier.py Transformer
+    conventions), residency-managed by the engine."""
+
+    def __init__(self, name: str, model_file: str, weights: str | None = None,
+                 *, ladder=None, max_batch: int = 0, mean=None,
+                 input_scale=None, raw_scale=None, channel_swap=None,
+                 image_dims=None, counter: CompileCounter | None = None,
+                 model_dir: str = ""):
+        import jax
+        self.name = name
+        param = NetParameter.from_file(model_file)
+        self.fwd = BucketedForward(param, ladder=ladder, max_batch=max_batch,
+                                   counter=counter, model_dir=model_dir)
+        params, state = self.fwd.init()
+        if weights:
+            from .. import io as _io
+            net = self.fwd._net_for(self.fwd.ladder[0])
+            params, state = net.import_weights(params, state,
+                                               _io.load_weights(weights))
+        # host master copy — the spill target; device residency is a
+        # device_put of exactly this tree
+        self.params_host = jax.tree_util.tree_map(np.asarray, params)
+        self.state_host = jax.tree_util.tree_map(np.asarray, state)
+        self.param_bytes = _tree_bytes(self.params_host) \
+            + _tree_bytes(self.state_host)
+        self._resident: tuple | None = None
+        self._upload_lock = threading.Lock()
+        self.was_spilled = False
+        # dispatches in flight on this model's device arrays (engine
+        # _lock guards it): spilling while > 0 frees nothing — the
+        # execution holds the buffers — so the LRU defers such victims
+        self.in_flight = 0
+
+        in_shape = self.fwd.input_shape()
+        in_blob = self.fwd.input_blob()
+        self.crop_dims = np.array(in_shape[2:]) if len(in_shape) == 4 \
+            else None
+        self.image_dims = np.array(image_dims) if image_dims is not None \
+            else self.crop_dims
+        self.transformer = caffe_io.Transformer.for_input(
+            in_blob, in_shape,
+            transpose=(2, 0, 1) if len(in_shape) == 4 else None,
+            mean=mean, input_scale=input_scale, raw_scale=raw_scale,
+            channel_swap=channel_swap)
+
+    # -- residency ------------------------------------------------------
+    @property
+    def resident(self) -> bool:
+        return self._resident is not None
+
+    def ensure_resident(self):
+        """Device-resident (params, state); uploads the host master copy
+        on first touch / after a spill. Compiled programs are untouched
+        either way — residency is data movement, never compilation.
+        Serialized per model: two threads racing here (dispatcher +
+        load_model) must not pay the multi-second upload twice."""
+        with self._upload_lock:
+            if self._resident is None:
+                import jax
+                self._resident = (jax.device_put(self.params_host),
+                                  jax.device_put(self.state_host))
+            return self._resident
+
+    def spill(self) -> None:
+        """Drop the device copy (HBM freed once in-flight work retires);
+        the host master copy and every compiled program survive."""
+        self._resident = None
+        self.was_spilled = True
+
+    # -- preprocessing --------------------------------------------------
+    def preprocess(self, img: np.ndarray) -> np.ndarray:
+        """HWC float image in [0,1] -> the net's input row (resize to
+        image_dims, center-crop to crop_dims, Transformer pipeline) —
+        the Classifier.predict(oversample=False) recipe."""
+        in_blob = self.fwd.input_blob()
+        if self.crop_dims is None:
+            return np.asarray(img, np.float32).reshape(
+                self.fwd.input_shape()[1:])
+        im = caffe_io.resize_center_crop(img, self.image_dims,
+                                         self.crop_dims)
+        return self.transformer.preprocess(in_blob, im)
+
+
+class ServingEngine:
+    """Multi-model residency + continuous batching + telemetry.
+
+    Knobs (ServingParameter, docs/serving.md): `serve_window_ms` —
+    batching window; `serve_buckets` — explicit bucket ladder;
+    `serve_hbm_mb` — HBM budget for resident weights (0 = unlimited),
+    enforced by LRU spill.
+    """
+
+    def __init__(self, serving_param: ServingParameter | None = None, *,
+                 window_ms: float | None = None, hbm_mb: float | None = None,
+                 buckets=None, start: bool = True):
+        # AOT warms go through the persistent XLA cache: a restarted
+        # server re-loads its zoo from disk hits, not fresh compiles
+        from ..utils.compile_cache import enable_compile_cache
+        enable_compile_cache()
+        sp = serving_param or ServingParameter()
+        self.window_ms = float(window_ms if window_ms is not None
+                               else sp.serve_window_ms)
+        budget_mb = float(hbm_mb if hbm_mb is not None else sp.serve_hbm_mb)
+        # reject nonsense at init like the other perf knobs (ISSUE 6
+        # convention): a negative budget would otherwise read as a
+        # never-satisfiable LRU target = perpetual spill thrash
+        if self.window_ms < 0:
+            raise ValueError(
+                f"serve_window_ms must be >= 0, got {self.window_ms}")
+        if budget_mb < 0:
+            raise ValueError(
+                f"serve_hbm_mb must be >= 0 (0 = unlimited), "
+                f"got {budget_mb}")
+        self.hbm_budget = int(budget_mb * 2**20)  # 0 = unlimited
+        self.ladder_spec = buckets if buckets is not None \
+            else (sp.serve_buckets or None)
+        self.counter = CompileCounter()
+        self._models: OrderedDict[str, InferenceModel] = OrderedDict()
+        self._lock = threading.RLock()
+        self.spills = 0
+        self.reloads = 0
+        # buckets warmed by models since REPLACED via load_model(same
+        # name): their compiles stay in the counter, so the invariant
+        # counts them on the warmed side too
+        self._retired_warmed = 0
+        # ladder buckets a load_model currently in flight will warm
+        self._pending_warm = 0
+        # models whose device upload is in flight (resident for budget
+        # math, but not yet spillable)
+        self._uploading: set[str] = set()
+        from .batcher import Batcher
+        self._batcher = Batcher(self)
+        if start:
+            self._batcher.start()
+
+    # -- model zoo ------------------------------------------------------
+    def load_model(self, name: str, model_file: str,
+                   weights: str | None = None, **preprocess) -> InferenceModel:
+        """Load + AOT-warm a model: every ladder bucket compiles NOW, so
+        steady-state traffic of any arrival-size mix runs zero compiles."""
+        model = InferenceModel(
+            name, model_file, weights, ladder=self.ladder_spec,
+            counter=self.counter, **preprocess)
+        # count the incoming ladder on the warmed side BEFORE warming:
+        # warm bumps the shared counter per bucket, and a /stats poll
+        # mid-load must not read compile_count > warmed_buckets as a
+        # false steady-state recompile
+        with self._lock:
+            self._pending_warm += len(model.fwd.ladder)
+        try:
+            model.fwd.warm(model.params_host, model.state_host)
+        except BaseException:
+            with self._lock:
+                self._pending_warm -= len(model.fwd.ladder)
+                # a partial warm's compiles stay in the counter forever
+                self._retired_warmed += len(model.fwd._compiled)
+            raise
+        with self._lock:
+            self._pending_warm -= len(model.fwd.ladder)
+            old = self._models.get(name)
+            if old is not None:
+                self._retired_warmed += len(old.fwd.ladder)
+            self._models[name] = model
+        self._make_resident(model)
+        log.info("serving: model %r loaded (%d bucket programs %s, "
+                 "%.1f MiB params)", name, len(model.fwd.ladder),
+                 model.fwd.ladder, model.param_bytes / 2**20)
+        return model
+
+    def model(self, name: str) -> InferenceModel:
+        with self._lock:
+            return self._models[name]
+
+    @property
+    def models(self) -> list[str]:
+        with self._lock:
+            return list(self._models)
+
+    @property
+    def compile_count(self) -> int:
+        return self.counter.count
+
+    @property
+    def warmed_buckets(self) -> int:
+        with self._lock:
+            return self._retired_warmed + self._pending_warm + sum(
+                len(m.fwd.ladder) for m in self._models.values())
+
+    def _make_resident(self, model: InferenceModel, *,
+                       mark_in_flight: bool = False):
+        """LRU admission: spill least-recently-used resident models until
+        `model` fits the HBM budget, then upload. A single model larger
+        than the whole budget stays resident with a warning (serving it
+        from host per request would pay the weight upload every batch).
+        mark_in_flight (the dispatcher) increments model.in_flight in
+        the same locked section that releases the upload reservation, so
+        the LRU can never observe a dispatch-bound model as spillable."""
+        with self._lock:
+            self._models.move_to_end(model.name)  # most recently used
+            # a model mid-upload elsewhere already counts as resident:
+            # its HBM is committed even though _resident is not set yet
+            was_resident = model.resident or model.name in self._uploading
+            if not was_resident and self.hbm_budget:
+                charged = [m for m in self._models.values()
+                           if (m.resident or m.name in self._uploading)
+                           and m is not model]
+                used = sum(m.param_bytes for m in charged)
+                deferred = False
+                for victim in charged:  # OrderedDict order = LRU first
+                    if used + model.param_bytes <= self.hbm_budget:
+                        break
+                    if victim.name in self._uploading \
+                            or victim.in_flight > 0:
+                        # spilling frees nothing while an upload or a
+                        # dispatched execution still holds the buffers
+                        # — crediting the budget here would over-commit
+                        # real HBM
+                        deferred = True
+                        continue
+                    victim.spill()
+                    self.spills += 1
+                    used -= victim.param_bytes
+                    log.info("serving: spilled %r (%.1f MiB) for %r",
+                             victim.name, victim.param_bytes / 2**20,
+                             model.name)
+                if used + model.param_bytes > self.hbm_budget:
+                    if deferred:
+                        log.warning(
+                            "serving: HBM budget transiently "
+                            "over-committed admitting %r (victims "
+                            "mid-upload or mid-dispatch cannot free "
+                            "HBM; reclaimed at their next LRU pass)",
+                            model.name)
+                    else:
+                        log.warning(
+                            "serving: model %r (%.1f MiB) alone exceeds "
+                            "the %.1f MiB HBM budget; keeping it "
+                            "resident anyway",
+                            model.name, model.param_bytes / 2**20,
+                            self.hbm_budget / 2**20)
+            if not was_resident and model.was_spilled:
+                self.reloads += 1
+            self._uploading.add(model.name)
+        # upload OUTSIDE the engine lock: a weight device_put takes
+        # seconds over the tunnel, and the dispatcher resolves models
+        # (engine.model -> this lock) while holding the batcher's
+        # condition variable — holding _lock here would stall every
+        # submit() across all models for the whole upload
+        try:
+            res = model.ensure_resident()
+        except BaseException:
+            with self._lock:
+                self._uploading.discard(model.name)
+            raise
+        with self._lock:
+            # hand off the _uploading reservation to the in_flight mark
+            # ATOMICALLY: a window where the model holds neither would
+            # let a concurrent LRU pass spill it and credit HBM the
+            # about-to-run dispatch still occupies
+            if mark_in_flight:
+                model.in_flight += 1
+            self._uploading.discard(model.name)
+        return res
+
+    def note_retire(self, model: InferenceModel) -> None:
+        """Batcher bookkeeping: the dispatch marked in flight by
+        `_make_resident(mark_in_flight=True)` has harvested (or failed);
+        its device arrays no longer pin the model's HBM."""
+        with self._lock:
+            model.in_flight -= 1
+
+    # -- request surface ------------------------------------------------
+    def submit(self, name: str, img: np.ndarray, *, preprocess: bool = True):
+        """Enqueue one image; returns a concurrent.futures.Future whose
+        result is the model's score row (np.ndarray)."""
+        model = self.model(name)  # KeyError for unknown models
+        data = model.preprocess(img) if preprocess else \
+            np.asarray(img, np.float32)
+        want = model.fwd.input_shape()[1:]
+        if tuple(data.shape) != tuple(want):
+            # reject HERE, in the caller's thread: a wrong-shaped row
+            # inside a batch would fail every co-batched request
+            raise ValueError(
+                f"serving: request row shape {tuple(data.shape)} does "
+                f"not match model {name!r} input {tuple(want)}")
+        return self._batcher.submit(name, data)
+
+    def classify(self, name: str, imgs, *, preprocess: bool = True
+                 ) -> np.ndarray:
+        """Synchronous convenience: submit all, gather rows in order."""
+        futures = [self.submit(name, im, preprocess=preprocess)
+                   for im in imgs]
+        return np.stack([f.result() for f in futures])
+
+    def drain(self, timeout: float = 60.0) -> None:
+        self._batcher.drain(timeout)
+
+    # -- telemetry ------------------------------------------------------
+    def stats(self) -> dict:
+        """Serving telemetry: p50/p99 end-to-end latency, sustained
+        img/s, dispatch fill, and the zero-recompile counters."""
+        recs = self._batcher.records()
+        out = {
+            "requests": len(recs),
+            "dispatches": self._batcher.dispatch_count,
+            "models": len(self.models),
+            "warmed_buckets": self.warmed_buckets,
+            "compile_count": self.compile_count,
+            "spills": self.spills,
+            "reloads": self.reloads,
+            "window_ms": self.window_ms,
+        }
+        if recs:
+            lat = np.sort(np.array([r["total_ms"] for r in recs]))
+            qms = np.array([r["queue_ms"] for r in recs])
+            first = min(r["t_enqueue"] for r in recs)
+            last = max(r["t_done"] for r in recs)
+            fills = [n / b
+                     for (_, n, b) in self._batcher.dispatch_snapshot()]
+            out.update({
+                "p50_ms": round(float(np.percentile(lat, 50)), 3),
+                "p99_ms": round(float(np.percentile(lat, 99)), 3),
+                "mean_queue_ms": round(float(qms.mean()), 3),
+                "img_per_s": round(len(recs) / max(last - first, 1e-9), 1),
+                "mean_bucket_fill": round(float(np.mean(fills)), 3),
+            })
+        return out
+
+    def close(self) -> None:
+        self._batcher.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,180 @@
+"""Stdlib HTTP front-end over the serving engine.
+
+Reference: examples/web_demo/app.py (Flask+Tornado upload form +
+classify-by-URL around a pycaffe Classifier). Flask is not in this
+image, so the surface is rebuilt on stdlib `http.server`
+(ThreadingHTTPServer) with the same routes:
+
+  GET  /                    upload form
+  POST /classify            multipart/form-data file field "image", or a
+                            raw image body (curl --data-binary)
+  GET  /classify_path?path= classify a file under image_root (the
+                            zero-egress analogue of the reference's
+                            /classify_url, which fetched from the web)
+  GET  /stats               serving telemetry JSON (engine.stats())
+
+Unlike the reference (and this repo's pre-ISSUE-7 demo), the handler
+does NOT run the model: it submits to the ServingEngine and waits on a
+future, so concurrent requests are continuously batched into padded
+bucket programs (batcher.py) instead of each paying a solo forward.
+Responses are JSON top-5 {label, score} like the reference's result
+tuples.
+"""
+
+from __future__ import annotations
+
+import email
+import email.policy
+import io as _io
+import json
+import os
+import sys
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+_FORM = (b"<html><body><h3>caffe_mpi_tpu classification demo</h3>"
+         b"<form method=post action=/classify enctype=multipart/form-data>"
+         b"<input type=file name=image> "
+         b"<input type=submit value=Classify></form></body></html>")
+
+
+def extract_image_bytes(body: bytes, content_type: str) -> bytes:
+    """Pull the uploaded file out of a multipart/form-data body (stdlib
+    email parser — the cgi module is deprecated); raw bodies pass
+    through."""
+    if content_type and content_type.startswith("multipart/"):
+        msg = email.message_from_bytes(
+            b"Content-Type: " + content_type.encode() + b"\r\n\r\n" + body,
+            policy=email.policy.HTTP)
+        fallback = None
+        for part in msg.iter_parts():
+            payload = part.get_payload(decode=True)
+            if not payload:
+                continue
+            name = part.get_param("name", header="content-disposition")
+            if name == "image":
+                return payload
+            # a form may carry extra fields; prefer any part that looks
+            # like a file upload over bare text fields
+            if fallback is None and part.get_filename():
+                fallback = payload
+        if fallback is not None:
+            return fallback
+        raise ValueError('no "image" file part in multipart body')
+    return body
+
+
+def decode_image(img_bytes: bytes) -> np.ndarray:
+    from PIL import Image
+    img = Image.open(_io.BytesIO(img_bytes)).convert("RGB")
+    return np.asarray(img, np.float32) / 255.0
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # injected by make_server:
+    engine = None
+    model_name = None
+    labels = None
+    image_root = None
+
+    def _json(self, code: int, obj) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _classify(self, img: np.ndarray) -> None:
+        try:
+            # submit + wait: the engine batches this request with every
+            # other in-flight one inside the batching window
+            preds = self.engine.submit(self.model_name, img).result(
+                timeout=60)
+            top = np.argsort(-preds)[:5]
+            body = {"predictions": [
+                # a short labels file falls back to the class index
+                # rather than crashing the handler mid-response
+                {"label": (self.labels[i] if self.labels
+                           and i < len(self.labels) else int(i)),
+                 # lint: ok(host-sync) — preds is a harvested numpy row
+                 "score": float(preds[i])} for i in top]}
+        except Exception as e:
+            return self._json(500, {"error": f"classification failed: {e}"})
+        self._json(200, body)
+
+    def do_GET(self):
+        url = urlparse(self.path)
+        if url.path == "/":
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Length", str(len(_FORM)))
+            self.end_headers()
+            self.wfile.write(_FORM)
+            return
+        if url.path == "/stats":
+            return self._json(200, self.engine.stats())
+        if url.path == "/classify_path":
+            if not self.image_root:
+                return self._json(403, {"error": "no --image-root given"})
+            rel = parse_qs(url.query).get("path", [""])[0]
+            full = os.path.realpath(os.path.join(self.image_root, rel))
+            root = os.path.realpath(self.image_root)
+            if not full.startswith(root + os.sep):
+                return self._json(403, {"error": "path outside image root"})
+            try:
+                with open(full, "rb") as f:
+                    raw = f.read()
+            except OSError as e:
+                return self._json(404, {"error": str(e)})
+            try:
+                img = decode_image(raw)
+            except Exception as e:  # exists but is not an image -> 400
+                return self._json(
+                    400, {"error": f"could not decode image: {e}"})
+            return self._classify(img)
+        self._json(404, {"error": f"no route {url.path}"})
+
+    def do_POST(self):
+        if urlparse(self.path).path != "/classify":
+            return self._json(404, {"error": "POST /classify"})
+        if "chunked" in (self.headers.get("Transfer-Encoding") or "").lower():
+            # http.server doesn't de-chunk; demand a sized body instead of
+            # reading 0 bytes and emitting a confusing decode error.
+            return self._json(411, {"error": "Content-Length required "
+                                             "(chunked uploads unsupported)"})
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:  # garbled header is a client error, not a crash
+            return self._json(400, {"error": "bad Content-Length"})
+        body = self.rfile.read(length)
+        try:
+            img = decode_image(extract_image_bytes(
+                body, self.headers.get("Content-Type", "")))
+        except Exception as e:  # bad upload is a client error, not a crash
+            return self._json(400, {"error": f"could not decode image: {e}"})
+        self._classify(img)
+
+    def log_message(self, fmt, *args):  # quiet by default
+        if os.environ.get("WEB_DEMO_VERBOSE"):
+            sys.stderr.write(fmt % args + "\n")
+
+
+def make_server(engine, model_name: str = "default", labels=None,
+                image_root: str | None = None, port: int = 5000,
+                host: str = "127.0.0.1") -> ThreadingHTTPServer:
+    """HTTP front-end over an already-loaded ServingEngine (port=0 picks
+    an ephemeral port — tests/smoke). `labels` is a list of class names
+    or a path to a labels file."""
+    if isinstance(labels, str):
+        with open(labels) as f:
+            labels = [line.strip() for line in f]
+    handler = type("Handler", (_Handler,), {
+        "engine": engine,
+        "model_name": model_name,
+        "labels": labels,
+        "image_root": image_root,
+    })
+    return ThreadingHTTPServer((host, port), handler)

@@ -1,4 +1,4 @@
-"""caffe CLI — train / test / time / device_query.
+"""caffe CLI — train / test / time / device_query / serve.
 
 Reference: tools/caffe.cpp (499 LoC): command registry, gflags (-solver,
 -model, -gpu, -snapshot, -weights, -iterations, -sigint_effect,
@@ -10,6 +10,7 @@ Usage (gflags-compatible single-dash long flags accepted):
     python -m caffe_mpi_tpu.tools.cli test -model net.prototxt -weights w.caffemodel -iterations 50
     python -m caffe_mpi_tpu.tools.cli time -model net.prototxt -iterations 50
     python -m caffe_mpi_tpu.tools.cli device_query
+    python -m caffe_mpi_tpu.tools.cli serve -model deploy.prototxt -weights w.caffemodel [-port 5000] [-smoke N]
 """
 
 from __future__ import annotations
@@ -29,7 +30,8 @@ log = logging.getLogger("caffe")
 def _parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="caffe", description=__doc__)
     p.add_argument("command",
-                   choices=["train", "test", "time", "device_query"])
+                   choices=["train", "test", "time", "device_query",
+                            "serve"])
     for flag, kw in [
         ("solver", dict(default="", help="solver prototxt")),
         ("model", dict(default="", help="net prototxt")),
@@ -168,6 +170,43 @@ def _parser() -> argparse.ArgumentParser:
                    help="multiply the solver's base_lr (set by the "
                    "supervisor on rewind_lr restarts; compounded per "
                    "numeric restart)")
+    # inference-serving flags (ISSUE 7, caffe_mpi_tpu/serving/)
+    p.add_argument("-port", "--port", type=int, default=5000,
+                   help="serve: HTTP port (0 picks an ephemeral port)")
+    p.add_argument("-labels", "--labels", default="",
+                   help="serve: class-label file, one label per line")
+    p.add_argument("-image_root", "--image-root", dest="image_root",
+                   default="",
+                   help="serve: allow GET /classify_path under this "
+                   "directory")
+    p.add_argument("-serve_window_ms", "--serve-window-ms",
+                   dest="serve_window_ms", type=float, default=-1.0,
+                   help="serve: continuous-batching window in ms — a "
+                   "batch dispatches when this long has passed since "
+                   "its first request, or earlier when a full max "
+                   "bucket is waiting (overrides ServingParameter "
+                   "serve_window_ms; -1 = schema default 5 ms; 0 = "
+                   "dispatch immediately)")
+    p.add_argument("-serve_buckets", "--serve-buckets",
+                   dest="serve_buckets", default="",
+                   help="serve: explicit padded-batch bucket ladder, "
+                   "comma-separated (e.g. '1,4,16') — every bucket is "
+                   "AOT-compiled at model load so arrival-size "
+                   "variance never recompiles (overrides "
+                   "ServingParameter serve_buckets; default geometric "
+                   "1,4,16,... up to the deploy batch)")
+    p.add_argument("-serve_hbm_mb", "--serve-hbm-mb",
+                   dest="serve_hbm_mb", type=float, default=-1.0,
+                   help="serve: HBM budget (MiB) for device-resident "
+                   "model weights; the least-recently-used model "
+                   "spills to its host master copy when exceeded "
+                   "(overrides ServingParameter serve_hbm_mb; -1 = "
+                   "schema default 0 = unlimited)")
+    p.add_argument("-smoke", "--smoke", type=int, default=0,
+                   help="serve: self-test — serve N synthetic requests "
+                   "of mixed sizes over real HTTP, print the telemetry "
+                   "JSON (p50/p99/img_s/compile_count), assert zero "
+                   "post-warmup compiles, and exit")
     return p
 
 
@@ -676,6 +715,124 @@ def cmd_time(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Production inference serving (ISSUE 7, caffe_mpi_tpu/serving/):
+    load the deploy net into a ServingEngine — params device-resident,
+    every padded batch bucket AOT-compiled NOW — and mount the stdlib
+    HTTP front-end on it. `-smoke N` runs the self-test path instead of
+    serving forever."""
+    from ..proto.config import ServingParameter
+    from ..serving import ServingEngine
+    from ..serving.http_front import make_server
+    if not args.model:
+        log.error("serve requires -model (a deploy prototxt)")
+        return 1
+    sp = ServingParameter()
+    if args.serve_window_ms >= 0:
+        sp.serve_window_ms = args.serve_window_ms
+    if args.serve_buckets:
+        sp.serve_buckets = args.serve_buckets
+    if args.serve_hbm_mb >= 0:
+        sp.serve_hbm_mb = args.serve_hbm_mb
+    engine = ServingEngine(sp)
+    engine.load_model("default", args.model, args.weights or None)
+    srv = make_server(engine, "default", labels=args.labels or None,
+                      image_root=args.image_root or None,
+                      port=args.port if not args.smoke else 0)
+    host, port = srv.server_address[:2]
+    if not args.smoke:
+        log.info("serving on http://%s:%s (model %s, buckets %s, "
+                 "window %.1f ms)", host, port, args.model,
+                 engine.model("default").fwd.ladder, engine.window_ms)
+        try:
+            srv.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            srv.shutdown()
+            engine.close()
+        return 0
+    return _serve_smoke(args, engine, srv)
+
+
+def _serve_smoke(args, engine, srv) -> int:
+    """`serve -smoke N`: fire N mixed-size synthetic requests — a few
+    over real HTTP (the full decode->submit->future path), the rest
+    straight into the engine — then print stats and verify the
+    zero-recompile claim (tools/tpu_validation.py serve stage)."""
+    import json
+    import threading
+    import urllib.request
+
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        model = engine.model("default")
+        shape = model.fwd.input_shape()
+        rng = np.random.RandomState(0)
+        if len(shape) == 4:
+            c, h, w = shape[1], shape[2], shape[3]
+
+            def synth():  # HWC with the net's OWN channel count
+                return rng.rand(h, w, c).astype(np.float32)
+        else:
+            def synth():  # non-image input: one row, preprocess reshapes
+                return rng.rand(*shape[1:]).astype(np.float32)
+        warmed = engine.compile_count
+        # the HTTP leg decodes uploads with PIL convert("RGB"), so it
+        # only makes sense for 3-channel image nets; others smoke the
+        # engine surface alone
+        n_http = min(4, args.smoke) \
+            if len(shape) == 4 and shape[1] == 3 else 0
+        http_err = None
+        sent_http = 0
+        try:
+            from PIL import Image
+            import io as _io
+            for _ in range(n_http):
+                buf = _io.BytesIO()
+                Image.fromarray(rng.randint(0, 255, (h, w, 3), np.uint8)
+                                ).save(buf, format="PNG")
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{srv.server_address[1]}/classify",
+                    data=buf.getvalue(),
+                    headers={"Content-Type": "image/png"})
+                json.loads(urllib.request.urlopen(req, timeout=60).read())
+                sent_http += 1
+        except ImportError:
+            log.warning("PIL missing; smoke skips the HTTP leg")
+        except Exception as e:  # noqa: BLE001 — an HTTP-leg failure must
+            # still print the telemetry JSON below before failing the smoke
+            http_err = e
+            log.error("serve smoke: HTTP leg failed: %s", e)
+        # the rest straight into the engine, in mixed-size bursts; count
+        # from requests actually SENT so a skipped/failed HTTP leg does
+        # not shrink the trace the operator asked for
+        left = args.smoke - sent_http
+        while left > 0:
+            burst = int(rng.randint(1, model.fwd.ladder[-1] + 1))
+            burst = min(burst, left)
+            engine.classify("default", [synth() for _ in range(burst)])
+            left -= burst
+        engine.drain()
+        stats = engine.stats()
+        stats["post_warmup_compiles"] = engine.compile_count - warmed
+        print(json.dumps({"serve_smoke": stats}))
+        if http_err is not None:
+            return 1
+        if stats["post_warmup_compiles"] != 0 or \
+                engine.compile_count != engine.warmed_buckets:
+            log.error("serve smoke: steady-state serving COMPILED "
+                      "(%d post-warmup; total %d vs %d warmed buckets)",
+                      stats["post_warmup_compiles"], engine.compile_count,
+                      engine.warmed_buckets)
+            return 1
+        return 0
+    finally:
+        srv.shutdown()
+        engine.close()
+
+
 def cmd_device_query(args) -> int:
     import jax
     for d in jax.devices():
@@ -704,6 +861,7 @@ def main(argv=None) -> int:
         "test": cmd_test,
         "time": cmd_time,
         "device_query": cmd_device_query,
+        "serve": cmd_serve,
     }[args.command](args)
 
 

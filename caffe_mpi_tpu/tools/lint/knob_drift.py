@@ -48,6 +48,9 @@ KNOBS = (
     "reduce_overlap",   # ISSUE 6: overlapped bucketed reduction
     "reduce_buckets",   # ISSUE 6: bucket count
     "grad_bucket_mb",   # ISSUE 6: bucket byte budget
+    "serve_window_ms",  # ISSUE 7: continuous-batching window
+    "serve_buckets",    # ISSUE 7: AOT padded-batch bucket ladder
+    "serve_hbm_mb",     # ISSUE 7: resident-model HBM budget (LRU spill)
 )
 
 CONFIG_FILE = os.path.join("caffe_mpi_tpu", "proto", "config.py")
@@ -63,14 +66,15 @@ _EXCLUDED_CONSUMER_DIRS = (os.path.join("caffe_mpi_tpu", "tools", "lint"),)
 
 
 def _solver_fields(path: str) -> dict[str, int]:
-    """{field_name: line} of SolverParameter's dataclass fields (and
-    NetParameter's, whose net-level knobs count as declarations too),
-    by AST — the pass must run without the package importable."""
+    """{field_name: line} of SolverParameter's dataclass fields (plus
+    NetParameter's net-level knobs and ServingParameter's serving-plane
+    knobs, which count as declarations too), by AST — the pass must run
+    without the package importable."""
     tree = ast.parse(open(path, encoding="utf-8").read(), filename=path)
     fields: dict[str, int] = {}
     for node in ast.walk(tree):
         if isinstance(node, ast.ClassDef) and node.name in (
-                "SolverParameter", "NetParameter"):
+                "SolverParameter", "NetParameter", "ServingParameter"):
             for stmt in node.body:
                 if isinstance(stmt, ast.AnnAssign) and isinstance(
                         stmt.target, ast.Name):
@@ -82,24 +86,25 @@ def _mentions(src: str, knob: str) -> bool:
     return knob in src
 
 
-def _consumes(tree: ast.Module | None, knob: str) -> bool:
-    """True when the AST READS the knob: a Load-context `x.knob`
-    attribute access, or a `"knob"` string literal passed as a call
-    argument (getattr(sp, "knob"), sp.has("knob")). A Store/Del-context
-    attribute (`sp.knob = args.knob` — plumbing) and a bare string
-    outside a call (docstrings, registry tuples) do not count."""
+def _reads(tree: ast.Module | None) -> set[str]:
+    """Names the AST READS: Load-context `x.attr` attribute accesses,
+    plus string literals passed as call arguments (getattr(sp, "knob"),
+    sp.has("knob")). Store/Del-context attributes (`sp.knob = args.knob`
+    — plumbing) and bare strings outside a call (docstrings, registry
+    tuples) are excluded. One walk per file serves every knob — the
+    per-knob rewalk made this the most expensive pass in the suite."""
+    reads: set[str] = set()
     if tree is None:
-        return False
+        return reads
     for node in ast.walk(tree):
-        if isinstance(node, ast.Attribute) and node.attr == knob \
-                and isinstance(node.ctx, ast.Load):
-            return True
-        if isinstance(node, ast.Call):
-            args = list(node.args) + [kw.value for kw in node.keywords]
-            if any(isinstance(a, ast.Constant) and a.value == knob
-                   for a in args):
-                return True
-    return False
+        if isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, ast.Load):
+            reads.add(node.attr)
+        elif isinstance(node, ast.Call):
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                    reads.add(a.value)
+    return reads
 
 
 @register
@@ -134,6 +139,8 @@ class KnobDriftPass(LintPass):
                         rel == d or rel.startswith(d + os.sep)
                         for d in _EXCLUDED_CONSUMER_DIRS):
                     continue
+                if consumed.issuperset(KNOBS):
+                    break
                 ctx = by_path.get(os.path.abspath(fp))
                 if ctx is not None:
                     tree = ctx.tree
@@ -143,9 +150,8 @@ class KnobDriftPass(LintPass):
                             open(fp, encoding="utf-8").read())
                     except SyntaxError:
                         continue
-                for knob in KNOBS:
-                    if knob not in consumed and _consumes(tree, knob):
-                        consumed.add(knob)
+                reads = _reads(tree)
+                consumed.update(k for k in KNOBS if k in reads)
 
         cfg_ctx = by_path.get(os.path.abspath(cfg_path))
         waivers = cfg_ctx.waivers if cfg_ctx is not None else {}
@@ -158,7 +164,7 @@ class KnobDriftPass(LintPass):
 
             missing = []
             if knob not in fields:
-                missing.append("a SolverParameter field in "
+                missing.append("a Solver/Net/ServingParameter field in "
                                + CONFIG_FILE)
             if not _mentions(cli_src, knob):
                 missing.append("a CLI flag in " + CLI_FILE)

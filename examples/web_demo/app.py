@@ -2,179 +2,59 @@
 
 The reference serves a Flask+Tornado app with an upload form and a
 classify-by-URL endpoint around a pycaffe Classifier. Flask is not in
-this image — and a demo that errors out is no demo — so this is built on
-the stdlib `http.server` instead (ThreadingHTTPServer), with the same
-surface:
+this image, and since ISSUE 7 the HTTP surface itself lives in the
+framework (`caffe_mpi_tpu/serving/http_front.py`, stdlib http.server):
+this demo is now a thin client that loads the model into a
+ServingEngine — params device-resident, every padded batch bucket
+AOT-compiled at load, concurrent uploads continuously batched — and
+mounts the stock front-end on it. Same surface as before:
 
   GET  /                    upload form
   POST /classify            multipart/form-data file field "image", or a
                             raw image body (curl --data-binary)
   GET  /classify_path?path= classify a file under --image-root
-                            (the zero-egress analogue of the reference's
-                            /classify_url, which fetched from the web)
+  GET  /stats               serving telemetry (p50/p99, img/s, compiles)
 
 Responses are JSON top-5 {label, score} like the reference's result
 tuples.
 
     python examples/web_demo/app.py -model deploy.prototxt \
         -weights w.caffemodel [-labels synset.txt] [-port 5000]
+
+The equivalent production entry point is
+    python -m caffe_mpi_tpu.tools.cli serve -model ... -weights ...
 """
 
 from __future__ import annotations
 
 import argparse
-import email
-import email.policy
-import io as _io
-import json
-import os
-import sys
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import parse_qs, urlparse
-
-import numpy as np
-
-_FORM = (b"<html><body><h3>caffe_mpi_tpu classification demo</h3>"
-         b"<form method=post action=/classify enctype=multipart/form-data>"
-         b"<input type=file name=image> "
-         b"<input type=submit value=Classify></form></body></html>")
-
-
-def _extract_image_bytes(body: bytes, content_type: str) -> bytes:
-    """Pull the uploaded file out of a multipart/form-data body (stdlib
-    email parser — the cgi module is deprecated); raw bodies pass
-    through."""
-    if content_type and content_type.startswith("multipart/"):
-        msg = email.message_from_bytes(
-            b"Content-Type: " + content_type.encode() + b"\r\n\r\n" + body,
-            policy=email.policy.HTTP)
-        fallback = None
-        for part in msg.iter_parts():
-            payload = part.get_payload(decode=True)
-            if not payload:
-                continue
-            name = part.get_param("name", header="content-disposition")
-            if name == "image":
-                return payload
-            # a form may carry extra fields; prefer any part that looks
-            # like a file upload over bare text fields
-            if fallback is None and part.get_filename():
-                fallback = payload
-        if fallback is not None:
-            return fallback
-        raise ValueError('no "image" file part in multipart body')
-    return body
-
-
-def _decode(img_bytes: bytes) -> np.ndarray:
-    from PIL import Image
-    img = Image.open(_io.BytesIO(img_bytes)).convert("RGB")
-    return np.asarray(img, np.float32) / 255.0
-
-
-class _Handler(BaseHTTPRequestHandler):
-    # injected by make_server:
-    clf = None
-    labels = None
-    image_root = None
-
-    def _json(self, code: int, obj) -> None:
-        body = json.dumps(obj).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
-
-    def _classify(self, img: np.ndarray) -> None:
-        try:
-            preds = self.clf.predict([img], oversample=False)[0]
-            top = np.argsort(-preds)[:5]
-            body = {"predictions": [
-                # a short labels file falls back to the class index
-                # rather than crashing the handler mid-response
-                {"label": (self.labels[i] if self.labels
-                           and i < len(self.labels) else int(i)),
-                 "score": float(preds[i])} for i in top]}
-        except Exception as e:
-            return self._json(500, {"error": f"classification failed: {e}"})
-        self._json(200, body)
-
-    def do_GET(self):
-        url = urlparse(self.path)
-        if url.path == "/":
-            self.send_response(200)
-            self.send_header("Content-Type", "text/html")
-            self.send_header("Content-Length", str(len(_FORM)))
-            self.end_headers()
-            self.wfile.write(_FORM)
-            return
-        if url.path == "/classify_path":
-            if not self.image_root:
-                return self._json(403, {"error": "no --image-root given"})
-            rel = parse_qs(url.query).get("path", [""])[0]
-            full = os.path.realpath(os.path.join(self.image_root, rel))
-            root = os.path.realpath(self.image_root)
-            if not full.startswith(root + os.sep):
-                return self._json(403, {"error": "path outside image root"})
-            try:
-                with open(full, "rb") as f:
-                    raw = f.read()
-            except OSError as e:
-                return self._json(404, {"error": str(e)})
-            try:
-                img = _decode(raw)
-            except Exception as e:  # exists but is not an image -> 400
-                return self._json(
-                    400, {"error": f"could not decode image: {e}"})
-            return self._classify(img)
-        self._json(404, {"error": f"no route {url.path}"})
-
-    def do_POST(self):
-        if urlparse(self.path).path != "/classify":
-            return self._json(404, {"error": "POST /classify"})
-        if "chunked" in (self.headers.get("Transfer-Encoding") or "").lower():
-            # http.server doesn't de-chunk; demand a sized body instead of
-            # reading 0 bytes and emitting a confusing decode error.
-            return self._json(411, {"error": "Content-Length required "
-                                             "(chunked uploads unsupported)"})
-        try:
-            length = int(self.headers.get("Content-Length", 0))
-        except ValueError:  # garbled header is a client error, not a crash
-            return self._json(400, {"error": "bad Content-Length"})
-        body = self.rfile.read(length)
-        try:
-            img = _decode(_extract_image_bytes(
-                body, self.headers.get("Content-Type", "")))
-        except Exception as e:  # bad upload is a client error, not a crash
-            return self._json(400, {"error": f"could not decode image: {e}"})
-        self._classify(img)
-
-    def log_message(self, fmt, *args):  # quiet by default
-        if os.environ.get("WEB_DEMO_VERBOSE"):
-            sys.stderr.write(fmt % args + "\n")
+from http.server import ThreadingHTTPServer
 
 
 def make_server(model: str, weights: str, labels_file: str | None = None,
                 image_root: str | None = None, port: int = 5000,
                 host: str = "127.0.0.1") -> ThreadingHTTPServer:
-    """Build the demo server (port=0 picks an ephemeral port — tests)."""
-    import caffe_mpi_tpu.pycaffe as caffe
+    """Build the demo server (port=0 picks an ephemeral port — tests).
 
-    labels = None
-    if labels_file:
-        with open(labels_file) as f:
-            labels = [line.strip() for line in f]
+    Signature kept from the pre-engine demo; the engine is parked on the
+    returned server as `.engine` so callers can close() it."""
+    from caffe_mpi_tpu.serving import ServingEngine
+    from caffe_mpi_tpu.serving.http_front import make_server as _front
 
-    handler = type("Handler", (_Handler,), {
-        "clf": caffe.Classifier(model, weights),
-        "labels": labels,
-        "image_root": image_root,
-    })
-    return ThreadingHTTPServer((host, port), handler)
+    engine = ServingEngine()
+    engine.load_model("default", model, weights or None)
+    srv = _front(engine, "default", labels=labels_file,
+                 image_root=image_root, port=port, host=host)
+    srv.engine = engine
+    return srv
 
 
 if __name__ == "__main__":
+    import os
+    import sys
+    _HERE = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.abspath(os.path.join(_HERE, "..", "..")))
+
     p = argparse.ArgumentParser()
     p.add_argument("-model", required=True)
     p.add_argument("-weights", required=True)

@@ -209,17 +209,24 @@ class TestDetectNetTransformationLayer:
         variant includes DIGITS Python layers (module
         caffe.layers.detectnet, shipped by DIGITS, not the reference), so
         a reference build without DIGITS cannot construct TEST either."""
+        import os
+
         from caffe_mpi_tpu.net import Net
         from caffe_mpi_tpu.proto import NetParameter
+
+        ref = "/root/reference/examples/kitti/detectnet_network.prototxt"
+        if not os.path.exists(ref):
+            # the read-only reference checkout is an environment fixture,
+            # not repo data — its absence is a skip, not a failure
+            pytest.skip(f"reference test data absent: {ref}")
 
         def probe(lp):
             return ((3, 384, 1248) if "data" in lp.top[0]
                     else (1, 16, 16))
 
-        net = Net(NetParameter.from_file(
-            "/root/reference/examples/kitti/detectnet_network.prototxt"),
-            phase=phase, stages=stages, data_shape_probe=probe,
-            device_transform=False)
+        net = Net(NetParameter.from_file(ref),
+                  phase=phase, stages=stages, data_shape_probe=probe,
+                  device_transform=False)
         batch = net.blob_shapes["data"][0]
         assert net.blob_shapes["transformed_data"] == (batch, 3, 384, 1248)
         # coverage head: 1 class -> 5 grid channels at stride 16

@@ -182,15 +182,20 @@ class TestEndToEnd:
         db, _, _ = self._make_db(tmp_path)
         (tmp_path / "net.prototxt").write_text(self.NET.format(db=db))
         sp = SolverParameter.from_text(
-            f'net: "{tmp_path}/net.prototxt"\nbase_lr: 0.5\n'
-            'lr_policy: "fixed"\nmax_iter: 12\ndisplay: 0\n')
+            f'net: "{tmp_path}/net.prototxt"\nbase_lr: 0.1\n'
+            'lr_policy: "fixed"\nmax_iter: 40\ndisplay: 0\n')
         solver = Solver(sp)
         assert solver.net.layers[0].dev_transform
         feeder = _build_feeders(solver.net, "TRAIN")
         assert feeder.device_transform
-        l0 = solver.step(1, feeder)
-        l1 = solver.step(11, feeder)
-        assert np.isfinite(l1) and l1 < l0
+        # convergence on a small memorizable set, deflaked: per-step
+        # losses oscillate epoch-to-epoch (8-record batches over 32
+        # records with aggressive augmentation), so compare EPOCH-scale
+        # averages instead of two individual steps — descent is the
+        # claim, not monotonicity
+        losses = [solver.step(1, feeder) for _ in range(sp.max_iter)]
+        assert np.all(np.isfinite(losses))
+        assert np.mean(losses[-8:]) < np.mean(losses[:8])
         feeder.close()
 
     def test_mixed_size_records_fall_back_to_host(self, tmp_path):

@@ -1,0 +1,508 @@
+"""Serving plane (ISSUE 7): bucket-ladder planner, batching-window
+semantics, LRU spill/reload, the zero-recompile proof, and score
+equality with the classic pad-to-declared-batch Classifier loop.
+
+Reference: python/caffe/classifier.py's static-batch forward is the
+behavior baseline; the serving engine must reproduce its scores exactly
+while batching/padding/residency happen around it.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import caffe_mpi_tpu.pycaffe as caffe
+from caffe_mpi_tpu.serving import (ServingEngine, bucket_for, plan_ladder)
+from caffe_mpi_tpu.serving.engine import BucketedForward
+
+TOY_NET = """
+name: "toy"
+layer {{ name: "data" type: "Input" top: "data"
+        input_param {{ shape {{ dim: {batch} dim: 3 dim: 8 dim: 8 }} }} }}
+layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "score"
+        inner_product_param {{ num_output: 5
+          weight_filler {{ type: "xavier" }} }} }}
+layer {{ name: "prob" type: "Softmax" bottom: "score" top: "prob" }}
+"""
+
+
+def write_toy(tmp_path, batch=8, name="deploy.prototxt", seed=0):
+    model = tmp_path / name
+    model.write_text(TOY_NET.format(batch=batch))
+    net = caffe.Net(str(model), caffe.TEST)
+    weights = str(tmp_path / (name + ".caffemodel"))
+    net.save(weights)
+    return str(model), weights
+
+
+def imgs(n, seed=0, hw=(8, 8)):
+    r = np.random.RandomState(seed)
+    return [r.rand(*hw, 3).astype(np.float32) for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# bucket-ladder planner
+
+class TestLadderPlanner:
+    def test_default_geometric(self):
+        assert plan_ladder(64) == (1, 4, 16, 64)
+        assert plan_ladder(16) == (1, 4, 16)
+        assert plan_ladder(10) == (1, 4, 10)
+
+    def test_max_one(self):
+        assert plan_ladder(1) == (1,)
+
+    def test_explicit_spec_string_and_iterable(self):
+        assert plan_ladder(6, "1,2,4") == (1, 2, 4, 6)
+        assert plan_ladder(6, [4, 2, 1]) == (1, 2, 4, 6)
+
+    def test_spec_dedup_and_clip_above_max(self):
+        assert plan_ladder(4, "1,1,8") == (1, 4)
+        assert plan_ladder(4, [2, 2, 4]) == (2, 4)
+
+    def test_spec_always_includes_max(self):
+        assert plan_ladder(9, "2")[-1] == 9
+
+    def test_invalid_specs_raise(self):
+        with pytest.raises(ValueError):
+            plan_ladder(0)
+        with pytest.raises(ValueError):
+            plan_ladder(8, "0,4")
+        with pytest.raises(ValueError):
+            plan_ladder(8, "-1")
+        with pytest.raises(ValueError):
+            plan_ladder(8, "a,b")
+        with pytest.raises(ValueError):
+            plan_ladder(8, "")
+        with pytest.raises(ValueError):
+            plan_ladder(8, [])
+
+    def test_bucket_for(self):
+        ladder = (1, 4, 16)
+        assert bucket_for(1, ladder) == 1
+        assert bucket_for(2, ladder) == 4
+        assert bucket_for(4, ladder) == 4
+        assert bucket_for(5, ladder) == 16
+        assert bucket_for(99, ladder) == 16  # callers chunk at max
+        with pytest.raises(ValueError):
+            bucket_for(0, ladder)
+
+
+# ---------------------------------------------------------------------------
+# engine basics + zero-recompile
+
+class TestZeroRecompile:
+    def test_warm_equals_ladder_and_steady_state_never_compiles(
+            self, tmp_path):
+        model, weights = write_toy(tmp_path, batch=8)
+        with ServingEngine(window_ms=5) as eng:
+            eng.load_model("a", model, weights)
+            eng.load_model("b", model, weights)
+            # every ladder bucket compiled at load, nothing else
+            assert eng.warmed_buckets == 2 * len(plan_ladder(8))
+            assert eng.compile_count == eng.warmed_buckets
+            at_warm = eng.compile_count
+            # mixed-size arrival trace across both resident models
+            r = np.random.RandomState(1)
+            for _ in range(12):
+                name = "a" if r.rand() < 0.5 else "b"
+                n = int(r.randint(1, 9))
+                scores = eng.classify(name, imgs(n, seed=n))
+                assert scores.shape == (n, 5)
+                np.testing.assert_allclose(scores.sum(1), 1.0, atol=1e-5)
+            eng.drain()
+            assert eng.compile_count == at_warm  # ZERO post-warmup compiles
+            st = eng.stats()
+            assert st["compile_count"] == st["warmed_buckets"]
+            assert st["requests"] > 0 and st["p99_ms"] >= st["p50_ms"] > 0
+            assert st["img_per_s"] > 0
+
+    def test_reload_same_name_keeps_invariant(self, tmp_path):
+        # replacing a model via load_model(same name) retires the old
+        # model's warmed buckets; the old compiles stay in the counter,
+        # so the invariant must count them on the warmed side too
+        model, weights = write_toy(tmp_path, batch=4)
+        with ServingEngine() as eng:
+            eng.load_model("a", model, weights)
+            eng.load_model("a", model, weights)  # e.g. updated weights
+            assert eng.compile_count == eng.warmed_buckets
+            scores = eng.classify("a", imgs(3))
+            assert scores.shape == (3, 5)
+            assert eng.compile_count == eng.warmed_buckets
+
+    def test_reload_during_open_window_dispatches_current_model(
+            self, tmp_path):
+        # a request waiting in an open batching window when load_model
+        # replaces its model must be scored by the CURRENT model's
+        # weights, not the retired object captured at window-open
+        m1, w1 = write_toy(tmp_path, batch=4, name="a.prototxt")
+        net = caffe.Net(m1, caffe.TEST)
+        net.copy_from(w1)
+        net.params["ip"][0].data = net.params["ip"][0].data * 3.0
+        w2 = str(tmp_path / "scaled.caffemodel")  # distinct weights
+        net.save(w2)
+        with ServingEngine(window_ms=60_000) as eng:
+            eng.load_model("m", m1, w1)
+            data = [im for im in imgs(4, seed=9)]
+            first = eng.submit("m", data[0])     # opens a 60s window
+            eng.load_model("m", m1, w2)          # reload mid-window
+            rest = [eng.submit("m", im) for im in data[1:]]
+            rows = np.stack([f.result(timeout=30)
+                             for f in [first] + rest])  # full bucket
+            want = eng.classify("m", data)       # current (w2) scores
+            np.testing.assert_allclose(rows, want, rtol=1e-6, atol=1e-7)
+            assert eng.compile_count == eng.warmed_buckets
+
+    def test_done_callback_reading_stats_does_not_deadlock(self, tmp_path):
+        # set_result runs done-callbacks synchronously in the harvest
+        # thread; a callback reading stats()/records() must not
+        # re-enter a lock the harvester is still holding
+        model, weights = write_toy(tmp_path)
+        with ServingEngine(window_ms=0) as eng:
+            eng.load_model("a", model, weights)
+            seen = []
+            fut = eng.submit("a", imgs(1)[0])
+            fut.add_done_callback(
+                lambda f: seen.append(eng.stats()["requests"]))
+            fut.result(timeout=30)
+            eng.drain(timeout=30)  # hangs if the harvester deadlocked
+            assert seen and seen[0] >= 1
+
+    def test_unknown_model_raises(self, tmp_path):
+        model, weights = write_toy(tmp_path)
+        with ServingEngine() as eng:
+            eng.load_model("a", model, weights)
+            with pytest.raises(KeyError):
+                eng.submit("nope", imgs(1)[0])
+
+    def test_wrong_shape_request_rejected_at_submit(self, tmp_path):
+        # a malformed row must fail in the CALLER's thread — inside a
+        # batch it would poison every co-batched request's future
+        model, weights = write_toy(tmp_path)
+        with ServingEngine() as eng:
+            eng.load_model("a", model, weights)
+            with pytest.raises(ValueError, match="row shape"):
+                eng.submit("a", np.zeros((5, 5, 5), np.float32),
+                           preprocess=False)
+            assert eng.classify("a", imgs(2)).shape == (2, 5)
+
+    def test_explicit_ladder_knob(self, tmp_path):
+        model, weights = write_toy(tmp_path, batch=8)
+        with ServingEngine(buckets="2,8") as eng:
+            m = eng.load_model("a", model, weights)
+            assert m.fwd.ladder == (2, 8)
+            assert eng.compile_count == 2
+
+    def test_negative_knobs_rejected_at_init(self):
+        with pytest.raises(ValueError, match="serve_window_ms"):
+            ServingEngine(window_ms=-1, start=False)
+        with pytest.raises(ValueError, match="serve_hbm_mb"):
+            ServingEngine(hbm_mb=-2, start=False)
+
+
+# ---------------------------------------------------------------------------
+# batching-window semantics
+
+class TestBatchingWindow:
+    def _engine(self, tmp_path, window_ms):
+        model, weights = write_toy(tmp_path, batch=4)
+        eng = ServingEngine(window_ms=window_ms)
+        eng.load_model("m", model, weights)
+        return eng
+
+    def test_full_max_bucket_closes_window_early(self, tmp_path):
+        # a 10s window must NOT make a full bucket wait 10s
+        eng = self._engine(tmp_path, window_ms=10_000)
+        t0 = time.perf_counter()
+        futs = [eng._batcher.submit("m", np.zeros((3, 8, 8), np.float32))
+                for _ in range(4)]
+        for f in futs:
+            f.result(timeout=30)
+        assert time.perf_counter() - t0 < 5.0
+        assert list(eng._batcher.dispatches) == [("m", 4, 4)]
+        eng.close()
+
+    def test_window_expiry_batches_partial_group(self, tmp_path):
+        eng = self._engine(tmp_path, window_ms=150)
+        futs = [eng._batcher.submit("m", np.zeros((3, 8, 8), np.float32))
+                for _ in range(3)]
+        for f in futs:
+            f.result(timeout=30)
+        # all three arrived inside one window: ONE dispatch, padded 3->4
+        assert list(eng._batcher.dispatches) == [("m", 3, 4)]
+        eng.close()
+
+    def test_zero_window_dispatches_immediately(self, tmp_path):
+        eng = self._engine(tmp_path, window_ms=0)
+        for _ in range(3):
+            eng._batcher.submit(
+                "m", np.zeros((3, 8, 8), np.float32)).result(timeout=30)
+        # sequential submit+wait: three solo dispatches on bucket 1
+        assert list(eng._batcher.dispatches) == [("m", 1, 1)] * 3
+        eng.close()
+
+    def test_close_cancels_pending_and_unblocks_drain(self, tmp_path):
+        # requests queued inside a long window when close() runs can
+        # never complete — they must come back CANCELLED, and drain()
+        # must not hang on their never-retired count
+        eng = self._engine(tmp_path, window_ms=60_000)
+        futs = [eng._batcher.submit("m", np.zeros((3, 8, 8), np.float32))
+                for _ in range(2)]
+        eng.close()
+        assert all(f.cancelled() for f in futs)
+        eng._batcher.drain(timeout=1.0)  # would TimeoutError pre-fix
+
+    def test_burst_larger_than_max_bucket_chunks(self, tmp_path):
+        eng = self._engine(tmp_path, window_ms=100)
+        scores = eng.classify("m", [im[:, :, :] for im in imgs(9, seed=3)])
+        assert scores.shape == (9, 5)
+        eng.drain()
+        total = sum(n for (_, n, _) in eng._batcher.dispatches)
+        assert total == 9
+        # no dispatch exceeds the max bucket
+        assert all(b <= 4 for (_, _, b) in eng._batcher.dispatches)
+        eng.close()
+
+    def test_interleaved_models_group_per_model(self, tmp_path):
+        model, weights = write_toy(tmp_path, batch=4)
+        with ServingEngine(window_ms=200) as eng:
+            eng.load_model("a", model, weights)
+            eng.load_model("b", model, weights)
+            futs = []
+            for name in ("a", "b", "a", "b", "a"):
+                futs.append(eng._batcher.submit(
+                    name, np.zeros((3, 8, 8), np.float32)))
+            for f in futs:
+                f.result(timeout=30)
+            # per-model grouping: one batch of 3 a's, one of 2 b's
+            got = sorted(eng._batcher.dispatches)
+            assert got == [("a", 3, 4), ("b", 2, 4)]
+
+
+# ---------------------------------------------------------------------------
+# LRU spill / reload
+
+class TestLRUResidency:
+    def test_spill_reload_round_trip(self, tmp_path):
+        model, weights = write_toy(tmp_path, batch=4)
+        with ServingEngine(window_ms=0) as eng:
+            a = eng.load_model("a", model, weights)
+            bytes_one = a.param_bytes / 2**20
+            # budget fits exactly one model
+            eng.hbm_budget = int(bytes_one * 1.5 * 2**20)
+            b = eng.load_model("b", model, weights)
+            assert b.resident and not a.resident  # a was LRU -> spilled
+            assert eng.spills == 1
+
+            ref = eng.classify("b", imgs(2, seed=7))
+            # serving the spilled model reloads it and evicts b
+            out_a = eng.classify("a", imgs(2, seed=7))
+            assert a.resident and not b.resident
+            assert eng.spills == 2 and eng.reloads >= 1
+            # round-trip: b comes back and scores are unchanged
+            out_b = eng.classify("b", imgs(2, seed=7))
+            assert b.resident and not a.resident
+            np.testing.assert_array_equal(ref, out_b)
+            # same prototxt + same weights file: a == b scores too
+            np.testing.assert_array_equal(out_a, out_b)
+            # residency churn never compiled anything new
+            assert eng.compile_count == eng.warmed_buckets
+
+    def test_oversized_model_stays_resident_with_unlimited_default(
+            self, tmp_path):
+        model, weights = write_toy(tmp_path, batch=4)
+        with ServingEngine() as eng:  # serve_hbm_mb 0 = unlimited
+            a = eng.load_model("a", model, weights)
+            b = eng.load_model("b", model, weights)
+            assert a.resident and b.resident and eng.spills == 0
+
+
+# ---------------------------------------------------------------------------
+# engine vs classic Classifier scores
+
+class TestClassifierEquality:
+    def _classic_forward(self, net, crops):
+        """The pre-ISSUE-7 Classifier loop: preprocess, pad every chunk
+        to the net's declared batch, forward, strip padding."""
+        in_ = net.inputs[0]
+        batch_size = net._net.blob_shapes[in_][0]
+        out_blob = net.outputs[-1]
+        preds = []
+        for start in range(0, len(crops), batch_size):
+            chunk = crops[start:start + batch_size]
+            data = np.stack([net.transformer.preprocess(in_, c)
+                             for c in chunk])
+            if len(data) < batch_size:
+                pad = np.zeros((batch_size - len(data), *data.shape[1:]),
+                               np.float32)
+                data = np.concatenate([data, pad])
+            out = net.forward(**{in_: data})
+            preds.append(out[out_blob][:len(chunk)])
+        return np.concatenate(preds)
+
+    def test_non_input_deploy_net_falls_back_to_classic_loop(
+            self, tmp_path):
+        # MemoryData-fed deploy nets have no rewritable Input batch dim
+        # — Classifier must keep the old declared-batch loop for them
+        net_txt = """
+name: "memtoy"
+layer { name: "data" type: "MemoryData" top: "data" top: "label"
+        memory_data_param { batch_size: 4 channels: 3
+                            height: 8 width: 8 } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "score"
+        inner_product_param { num_output: 5
+          weight_filler { type: "xavier" } } }
+layer { name: "prob" type: "Softmax" bottom: "score" top: "prob" }
+"""
+        model = tmp_path / "mem.prototxt"
+        model.write_text(net_txt)
+        net = caffe.Net(str(model), caffe.TEST)
+        weights = str(tmp_path / "mem.caffemodel")
+        net.save(weights)
+        clf = caffe.Classifier(str(model), weights, image_dims=(8, 8))
+        preds = clf.predict(imgs(3, seed=2), oversample=False)
+        assert preds.shape == (3, 5)
+        np.testing.assert_allclose(preds.sum(1), 1.0, atol=1e-5)
+        assert clf._bucket_fwd is False  # classic loop engaged
+
+    def test_multi_input_deploy_net_falls_back_to_classic_loop(
+            self, tmp_path):
+        # two-Input deploy nets pass BucketedForward's constructor but
+        # fail its one-input check at forward time; Classifier must
+        # fall back (pycaffe zero-fills the unfed second input)
+        net_txt = """
+name: "twotoy"
+layer { name: "data" type: "Input" top: "data"
+        input_param { shape { dim: 4 dim: 3 dim: 8 dim: 8 } } }
+layer { name: "aux" type: "Input" top: "aux"
+        input_param { shape { dim: 4 dim: 2 } } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "score"
+        inner_product_param { num_output: 5
+          weight_filler { type: "xavier" } } }
+layer { name: "prob" type: "Softmax" bottom: "score" top: "prob" }
+"""
+        model = tmp_path / "two.prototxt"
+        model.write_text(net_txt)
+        net = caffe.Net(str(model), caffe.TEST)
+        weights = str(tmp_path / "two.caffemodel")
+        net.save(weights)
+        clf = caffe.Classifier(str(model), weights, image_dims=(8, 8))
+        preds = clf.predict(imgs(2, seed=4), oversample=False)
+        assert preds.shape == (2, 5)
+        np.testing.assert_allclose(preds.sum(1), 1.0, atol=1e-5)
+        assert clf._bucket_fwd is False  # classic loop engaged
+
+    def test_empty_crop_list_raises_cleanly(self, tmp_path):
+        model, weights = write_toy(tmp_path, batch=4)
+        clf = caffe.Classifier(model, weights, image_dims=(8, 8))
+        with pytest.raises(ValueError, match="empty input"):
+            clf._forward_batched([])
+
+    def test_predict_populates_net_blobs(self, tmp_path):
+        # pycaffe parity: after predict(), net.blobs exposes every blob
+        # of the last executed batch (the standard feature-extraction
+        # pattern) — the bucketed path must keep the contract
+        model, weights = write_toy(tmp_path, batch=4)
+        clf = caffe.Classifier(model, weights, image_dims=(8, 8))
+        preds = clf.predict(imgs(2, seed=5), oversample=False)
+        prob = clf.blobs["prob"].data
+        np.testing.assert_allclose(prob[:2], preds, rtol=1e-6, atol=1e-7)
+        assert clf.blobs["score"].data.shape[1] == 5  # intermediates too
+
+    @pytest.mark.parametrize("n", [1, 2, 4, 7])
+    def test_classifier_matches_classic_loop(self, tmp_path, n):
+        model, weights = write_toy(tmp_path, batch=4)
+        clf = caffe.Classifier(model, weights, image_dims=(8, 8))
+        crops = imgs(n, seed=n)
+        classic = self._classic_forward(clf, list(crops))
+        bucketed = clf._forward_batched(list(crops))
+        np.testing.assert_allclose(bucketed, classic, rtol=1e-6, atol=1e-7)
+
+    def test_predict_oversample_shapes_and_rows(self, tmp_path):
+        model, weights = write_toy(tmp_path, batch=4)
+        clf = caffe.Classifier(model, weights, image_dims=(10, 10))
+        preds = clf.predict(imgs(2, seed=5, hw=(12, 12)), oversample=True)
+        assert preds.shape == (2, 5)
+        np.testing.assert_allclose(preds.sum(1), 1.0, atol=1e-5)
+
+    def test_engine_matches_classifier(self, tmp_path):
+        model, weights = write_toy(tmp_path, batch=4)
+        clf = caffe.Classifier(model, weights)
+        with ServingEngine(window_ms=50) as eng:
+            eng.load_model("m", model, weights)
+            ims = imgs(5, seed=9)
+            want = clf.predict(ims, oversample=False)
+            got = eng.classify("m", ims)
+            np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+    def test_detector_still_detects(self, tmp_path):
+        model, weights = write_toy(tmp_path, batch=4)
+        from PIL import Image
+        img = Image.fromarray(np.random.RandomState(0).randint(
+            0, 255, (16, 16, 3), np.uint8))
+        fname = str(tmp_path / "im.png")
+        img.save(fname)
+        det = caffe.Detector(model, weights)
+        out = det.detect_windows([(fname, [(0, 0, 12, 12), (2, 2, 15, 15),
+                                           (1, 0, 9, 14)])])
+        assert len(out) == 3
+        for o in out:
+            assert o["prediction"].shape == (5,)
+
+
+# ---------------------------------------------------------------------------
+# BucketedForward surface details
+
+class TestBucketedForward:
+    def test_multi_input_net_rejected(self, tmp_path):
+        from caffe_mpi_tpu.proto import NetParameter
+        two = NetParameter.from_text("""
+        layer { name: "d" type: "Input" top: "x" top: "y"
+                input_param { shape { dim: 2 dim: 3 }
+                              shape { dim: 2 dim: 3 } } }
+        layer { name: "add" type: "Eltwise" bottom: "x" bottom: "y"
+                top: "s" }
+        """)
+        fwd = BucketedForward(two)
+        with pytest.raises(ValueError, match="one input blob"):
+            fwd.init()
+
+    def test_no_input_layer_rejected(self):
+        from caffe_mpi_tpu.proto import NetParameter
+        with pytest.raises(ValueError, match="deploy prototxt"):
+            BucketedForward(NetParameter.from_text("""
+            layer { name: "d" type: "DummyData" top: "x"
+                    dummy_data_param { shape { dim: 2 dim: 3 } } }
+            """))
+
+    def test_cold_bucket_compile_is_counted(self, tmp_path):
+        from caffe_mpi_tpu.proto import NetParameter
+        param = NetParameter.from_text(TOY_NET.format(batch=8))
+        fwd = BucketedForward(param, ladder=(2, 8))
+        params, state = fwd.init()
+        # no warm(): the first forward compiles on demand — and counts
+        assert fwd.counter.count == 0
+        out = fwd.forward(params, state, np.zeros((2, 3, 8, 8), np.float32))
+        assert out.shape == (2, 5)
+        assert fwd.counter.count == 1
+        # same bucket again: cached, no new compile
+        fwd.forward(params, state, np.zeros((1, 3, 8, 8), np.float32))
+        assert fwd.counter.count == 1  # 1 -> bucket 2, already built
+
+    def test_smoke_cli(self, tmp_path, capsys):
+        """`caffe serve -smoke N` end to end (HTTP + engine legs)."""
+        from caffe_mpi_tpu.tools.cli import main as cli_main
+        model, weights = write_toy(tmp_path, batch=4)
+        rc = cli_main(["serve", "-model", model, "-weights", weights,
+                       "-smoke", "8", "-serve_window_ms", "20"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        import json
+        line = [l for l in out.splitlines() if l.startswith("{")][-1]
+        stats = json.loads(line)["serve_smoke"]
+        assert stats["post_warmup_compiles"] == 0
+        assert stats["compile_count"] == stats["warmed_buckets"]
+        assert stats["requests"] >= 8

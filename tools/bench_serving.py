@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Serving-plane benchmark — prints ONE JSON line (bench.py `serving`).
+
+Reference: the reference framework publishes no serving numbers (its
+deployment story, examples/web_demo + extract_features, is unmetered);
+this is the measurement ISSUE 7's acceptance demands: a mixed-size
+synthetic arrival trace across TWO resident models under an HBM budget
+must run **zero post-warmup compiles** (compile_count == warmed bucket
+count) while reporting p50/p99 end-to-end latency and sustained img/s.
+
+Runs CPU-forced by default so the zero-recompile proof stays visible
+when the TPU tunnel is down (bench.py embeds this output either way);
+set CAFFE_BENCH_SERVING_DEVICE=1 to measure on the real chip
+(tools/tpu_validation.py's serve stage covers the hardware HTTP path
+via `caffe serve -smoke`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+if os.environ.get("CAFFE_BENCH_SERVING_DEVICE") != "1":
+    # must land before any jax computation (backends init lazily)
+    os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+CONV_NET = """
+name: "serve_conv"
+layer { name: "data" type: "Input" top: "data"
+        input_param { shape { dim: 16 dim: 3 dim: 16 dim: 16 } } }
+layer { name: "conv" type: "Convolution" bottom: "data" top: "c"
+        convolution_param { num_output: 8 kernel_size: 3 stride: 2
+          weight_filler { type: "xavier" } } }
+layer { name: "ip" type: "InnerProduct" bottom: "c" top: "score"
+        inner_product_param { num_output: 10
+          weight_filler { type: "xavier" } } }
+layer { name: "prob" type: "Softmax" bottom: "score" top: "prob" }
+"""
+
+MLP_NET = """
+name: "serve_mlp"
+layer { name: "data" type: "Input" top: "data"
+        input_param { shape { dim: 8 dim: 1 dim: 8 dim: 8 } } }
+layer { name: "ip1" type: "InnerProduct" bottom: "data" top: "h"
+        inner_product_param { num_output: 32
+          weight_filler { type: "xavier" } } }
+layer { name: "relu" type: "ReLU" bottom: "h" top: "h" }
+layer { name: "ip2" type: "InnerProduct" bottom: "h" top: "score"
+        inner_product_param { num_output: 5
+          weight_filler { type: "xavier" } } }
+layer { name: "prob" type: "Softmax" bottom: "score" top: "prob" }
+"""
+
+REQUESTS = int(os.environ.get("CAFFE_BENCH_SERVING_REQS", 200))
+WINDOW_MS = float(os.environ.get("CAFFE_BENCH_SERVING_WINDOW_MS", 2.0))
+
+
+def main() -> int:
+    import numpy as np
+    from caffe_mpi_tpu.serving import ServingEngine
+
+    tmp = tempfile.mkdtemp(prefix="caffe_serve_bench_")
+    nets = {"conv": CONV_NET, "mlp": MLP_NET}
+    paths = {}
+    for name, text in nets.items():
+        paths[name] = os.path.join(tmp, f"{name}.prototxt")
+        with open(paths[name], "w") as f:
+            f.write(text)
+
+    # phase 1, unlimited HBM: both models resident — this trace measures
+    # steady-state latency, which residency thrash would pollute; the
+    # budgeted LRU path gets its own phase below
+    eng = ServingEngine(window_ms=WINDOW_MS)
+    t_load0 = time.perf_counter()
+    for name in nets:
+        eng.load_model(name, paths[name])
+    load_ms = (time.perf_counter() - t_load0) * 1e3
+    warmed = eng.warmed_buckets
+    compiles_at_warm = eng.compile_count
+
+    # mixed-size arrival trace: bursts of 1..max interleaved across the
+    # two models, drained fully before reading stats
+    rng = np.random.RandomState(0)
+    shapes = {"conv": (16, 16, 3), "mlp": (8, 8, 1)}
+    sent = 0
+    futures = []
+    while sent < REQUESTS:
+        name = "conv" if rng.rand() < 0.5 else "mlp"
+        maxb = eng.model(name).fwd.ladder[-1]
+        burst = int(rng.randint(1, maxb + 1))
+        for _ in range(min(burst, REQUESTS - sent)):
+            h, w, c = shapes[name]
+            img = rng.rand(h, w, c).astype(np.float32)
+            futures.append(eng.submit(name, img))
+            sent += 1
+    eng.drain(timeout=120)
+    for f in futures:
+        f.result(timeout=1)  # surfaces any dispatch failure loudly
+
+    stats = eng.stats()
+    stats["load_ms"] = round(load_ms, 1)
+    stats["requests_sent"] = sent
+    stats["post_warmup_compiles"] = eng.compile_count - compiles_at_warm
+    stats["zero_recompile"] = (stats["post_warmup_compiles"] == 0
+                               and eng.compile_count == warmed)
+
+    # budgeted phase: a SECOND engine under a deliberately tight HBM
+    # budget (one model fits, both do not) proves the LRU path live —
+    # alternating traffic spills and reloads, and reloads are pure
+    # device_puts, never recompiles. Kept separate so residency thrash
+    # cannot pollute the steady-state latency numbers above.
+    sizes = [eng.model(n).param_bytes for n in nets]
+    budget_mb = (max(sizes) + min(sizes) / 2) / 2**20
+    eng.close()
+    eng2 = ServingEngine(window_ms=0, hbm_mb=budget_mb)
+    for name in nets:
+        eng2.load_model(name, paths[name])
+    warmed2 = eng2.warmed_buckets
+    compiles2 = eng2.compile_count
+    for i in range(6):  # alternate models -> every round spills one
+        name = ("conv", "mlp")[i % 2]
+        h, w, c = shapes[name]
+        eng2.classify(name, [rng.rand(h, w, c).astype(np.float32)])
+    eng2.drain(timeout=60)
+    stats["budgeted"] = {
+        "hbm_mb": round(budget_mb, 3),
+        "spills": eng2.spills,
+        "reloads": eng2.reloads,
+        "post_warmup_compiles": eng2.compile_count - compiles2,
+        "zero_recompile": (eng2.compile_count == warmed2
+                           and eng2.spills > 0 and eng2.reloads > 0),
+    }
+    eng2.close()
+
+    import jax
+    stats["platform"] = jax.devices()[0].platform
+    print(json.dumps({"serving": stats}))
+    return 0 if (stats["zero_recompile"]
+                 and stats["budgeted"]["zero_recompile"]) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

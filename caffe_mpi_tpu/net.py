@@ -49,7 +49,8 @@ class Net:
                  batch_divisor: int = 1,
                  data_shape_probe=None, model_dir: str = "",
                  solver_storage: str = "FLOAT",
-                 device_transform: bool | None = None):
+                 device_transform: bool | None = None,
+                 precision: str = ""):
         """batch_divisor: divide data-layer batch sizes by the per-replica
         count, reproducing divide_batch_size (reference parallel.cpp:295-348).
         data_shape_probe: callable(layer_param) -> (C,H,W) for DB-backed
@@ -65,7 +66,14 @@ class Net:
         are rejected.
         device_transform: None (auto — in-graph crop/mean/mirror/scale for
         eligible Data layers, the use_gpu_transform analogue) or False to
-        force the host transform path (manual-feed surfaces: pycaffe)."""
+        force the host transform path (manual-feed surfaces: pycaffe).
+        precision: the solver-level compute-precision override (ISSUE 9,
+        SolverParameter.precision). "" / "f32" (default) keeps the
+        prototxt's own dtype declarations, bitwise. "bf16" makes the
+        NET-LEVEL default forward/backward type FLOAT16 (-> bfloat16 on
+        TPU) — the one-knob spelling of NVCaffe's fp16 prototxt variants
+        — while per-layer forward_type/backward_type overrides still
+        win, exactly as they do against the prototxt net defaults."""
         self.model_dir = model_dir
         param = normalize_net(param)
         state = NetState(phase=phase, level=level, stage=list(stages))
@@ -93,10 +101,34 @@ class Net:
                 f"unsupported solver_data_type {solver_storage!r}: learnable "
                 "params must be floating point (FLOAT, FLOAT16, or DOUBLE)")
         solver_storage = solver_storage or "FLOAT"
+        if precision not in ("", "f32", "bf16"):
+            raise ValueError(f"unknown precision {precision!r} "
+                             "(expected 'f32' or 'bf16')")
+        # precision: bf16 rewrites the NET-LEVEL dtype defaults only —
+        # resolution order (layer override > net default) is untouched,
+        # so a prototxt that pins a layer to FLOAT keeps it f32
+        net_fwd = param.default_forward_type
+        net_bwd = param.default_backward_type
+        if precision == "bf16":
+            net_fwd = "FLOAT16" if not param.has("default_forward_type") \
+                else net_fwd
+            net_bwd = "FLOAT16" if not param.has("default_backward_type") \
+                else net_bwd
+            if "FLOAT16" not in (net_fwd, net_bwd):
+                # the knob lost to explicit prototxt defaults on BOTH
+                # sides: say so, or `-precision bf16` silently trains
+                # f32 (loss scaling armed for nothing, speedup ~1.0)
+                log.warning(
+                    "precision: bf16 requested, but the net prototxt "
+                    "explicitly sets default_forward_type/"
+                    "default_backward_type (%s/%s) and the prototxt "
+                    "wins — bf16 did not engage net-wide (per-layer "
+                    "forward_type overrides may still apply)",
+                    net_fwd, net_bwd)
         for lp in param.layer:
             policy = DtypePolicy.resolve(
                 lp.forward_type, lp.backward_type,
-                param.default_forward_type, param.default_backward_type,
+                net_fwd, net_bwd,
                 solver_storage,
                 lp.forward_math, param.default_forward_math,
                 lp.backward_math, param.default_backward_math,

@@ -76,10 +76,18 @@ class Bucket:
 
 @dataclass(frozen=True)
 class ReductionPlan:
-    """The bucket schedule plus the mesh facts the packed psum needs."""
+    """The bucket schedule plus the mesh facts the packed psum needs.
+
+    wire_dtype (ISSUE 9): when set (e.g. "bfloat16" under `precision:
+    bf16`), each packed bucket is CAST to this dtype before its psum —
+    the collective moves half the bytes — and cast back to the gradient
+    dtype right after, so the post-psum 1/n scale, clipping, and the
+    optimizer update all run in f32. None (default) reduces in the
+    gradient's own dtype, bitwise-identical to before the knob."""
     buckets: tuple[Bucket, ...]
     n_data: int
     axis: str = "data"
+    wire_dtype: str | None = None
 
     @property
     def bucket_bytes(self) -> tuple[int, ...]:
@@ -92,13 +100,16 @@ class ReductionPlan:
         return len(self.buckets)
 
     def stats(self) -> dict:
-        return {
+        out = {
             "mode": "bucketed",
             "reduce_buckets": len(self.buckets),
             "collectives_per_step": self.collectives_per_step,
             "bucket_bytes": list(self.bucket_bytes),
             "n_data": self.n_data,
         }
+        if self.wire_dtype:
+            out["wire_dtype"] = self.wire_dtype
+        return out
 
     def psum_buckets(self, grads, pred=None):
         """Reduce a congruent grad pytree bucket-by-bucket inside
@@ -124,12 +135,19 @@ class ReductionPlan:
         import jax.numpy as jnp
         from jax import lax
 
+        wire = self.wire_dtype
         reds = []
         for bucket in self.buckets:
             parts = [grads[ln][pn].reshape(-1)
                      for (ln, pn) in bucket.entries]
             flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+            if wire and str(flat.dtype) != wire:
+                # ISSUE 9: the collective moves bf16 — half the bytes on
+                # the wire; everything after the psum is f32 again
+                flat = flat.astype(wire)
             red = lax.psum(flat, self.axis)
+            if wire:
+                red = red.astype(jnp.float32)
             if self.n_data > 1:
                 red = red / self.n_data
             reds.append(red)
@@ -158,7 +176,8 @@ class ReductionPlan:
 
 def plan_buckets(entries, *, n_buckets: int = 0,
                  bucket_bytes: int = 0, n_data: int = 1,
-                 axis: str = "data") -> ReductionPlan:
+                 axis: str = "data",
+                 wire_dtype: str | None = None) -> ReductionPlan:
     """Pack `entries` — an iterable of (layer, param, shape, dtype) in
     REVERSE topological layer order, i.e. the order backward produces
     gradients — into contiguous buckets.
@@ -176,11 +195,14 @@ def plan_buckets(entries, *, n_buckets: int = 0,
                          "bucket_bytes > 0")
     ents = []
     for (lname, pname, shape, dtype) in entries:
-        dt = np.dtype(dtype)
+        # wire_dtype (ISSUE 9): buckets pack and travel in this dtype —
+        # sizing, budgets, and the reported bucket_bytes follow it
+        dt = np.dtype(wire_dtype) if wire_dtype else np.dtype(dtype)
         size = int(np.prod(shape, dtype=np.int64)) if shape else 1
         ents.append((lname, pname, size, dt))
     if not ents:
-        return ReductionPlan(buckets=(), n_data=n_data, axis=axis)
+        return ReductionPlan(buckets=(), n_data=n_data, axis=axis,
+                             wire_dtype=wire_dtype)
 
     total = sum(s * dt.itemsize for (_, _, s, dt) in ents)
     buckets: list[Bucket] = []
@@ -237,11 +259,13 @@ def plan_buckets(entries, *, n_buckets: int = 0,
                     or remaining <= still_needed):
                 flush()
         flush()
-    return ReductionPlan(buckets=tuple(buckets), n_data=n_data, axis=axis)
+    return ReductionPlan(buckets=tuple(buckets), n_data=n_data, axis=axis,
+                         wire_dtype=wire_dtype)
 
 
 def plan_for_net(net, params, *, n_buckets: int = 0,
-                 bucket_bytes: int = 0, n_data: int = 1) -> ReductionPlan:
+                 bucket_bytes: int = 0, n_data: int = 1,
+                 wire_dtype: str | None = None) -> ReductionPlan:
     """Bucket plan over a Net's param pytree, layers reversed (backward
     order). Every leaf of `params` must land in exactly one bucket —
     clipping consumes the whole grad tree, so an uncovered leaf would
@@ -267,7 +291,8 @@ def plan_for_net(net, params, *, n_buckets: int = 0,
             f"bucket planner lost params {sorted(missing)} — params "
             "exist outside the net's layer list")
     return plan_buckets(entries, n_buckets=n_buckets,
-                        bucket_bytes=bucket_bytes, n_data=n_data)
+                        bucket_bytes=bucket_bytes, n_data=n_data,
+                        wire_dtype=wire_dtype)
 
 
 # ---------------------------------------------------------------------------

@@ -1030,6 +1030,29 @@ class SolverParameter(Message):
     # warning). 0 (default) = use the bucket count. Negative rejected;
     # setting both this and reduce_buckets is an error.
     grad_bucket_mb: float = 0.0
+    # TPU-native extension (ISSUE 9, mixed-precision bf16 training —
+    # docs/benchmarks.md "Mixed-precision bf16 training"): whole-run
+    # compute precision. "f32" (default) = today's behavior, bitwise.
+    # "bf16" = activations and gradients compute in bfloat16 (the TPU
+    # MXU's native 16-bit format) while parameters and optimizer slots
+    # stay f32 MASTER copies — params cast to bf16 at use inside the
+    # step, updates applied in f32 — threaded through Net compile, the
+    # fused K-step scan, fused eval, and reduce_overlap (buckets pack
+    # and psum in bf16, halving collective bytes; post-psum math in
+    # f32). Orthogonal to the per-layer forward_type/backward_type
+    # overrides, which still win where set.
+    precision: str = "f32"
+    # loss scaling for the bf16 backward (consumed only when precision
+    # is bf16): 0 (default) = DYNAMIC — the scale rides the train-scan
+    # carry, halves on a non-finite (overflow) step (which is SKIPPED,
+    # not applied, and never trips the exit-88 divergence policy until
+    # the scale is already at its floor), and doubles again after
+    # loss_scale_window consecutive clean steps. > 0 = that fixed
+    # static scale (grads unwound by 1/scale in f32 before the update).
+    loss_scale: float = 0.0
+    # consecutive clean (non-overflow) steps before the dynamic loss
+    # scale grows 2x (capped); ignored for static scales.
+    loss_scale_window: int = 200
     # TPU-native extension (ISSUE 3): dispatch watchdog deadline in
     # seconds. >0 arms a monitor thread that journals the run state and
     # hard-exits (exit code 86) when any device dispatch/harvest blocks
@@ -1068,6 +1091,13 @@ class ServingParameter(Message):
     # to the host master copy (compiled programs survive a spill).
     # 0 (default) = unlimited, everything stays resident.
     serve_hbm_mb: float = 0.0
+    # compute precision for this model's bucket programs (ISSUE 9):
+    # "f32" (default) = today's behavior; "bf16" = the bucket forwards
+    # compute in bfloat16 (scores cast back to f32 at the program
+    # boundary, so the classify/detect surfaces are unchanged). The
+    # ladder is compiled once per model either way — a dtype choice is
+    # load-time, so steady-state serving still performs ZERO compiles.
+    serve_dtype: str = "f32"
 
 
 SOLVER_TYPE_NAMES = {

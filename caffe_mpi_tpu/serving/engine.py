@@ -126,9 +126,21 @@ class BucketedForward:
     def __init__(self, net_param: NetParameter, *, ladder=None,
                  max_batch: int = 0, out_blob: str | None = None,
                  model_dir: str = "", counter: CompileCounter | None = None,
-                 full_env: bool = False):
+                 full_env: bool = False, dtype: str = "f32"):
         self._base = copy.deepcopy(net_param)
         self._model_dir = model_dir
+        # serve_dtype (ISSUE 9): "bf16" compiles every bucket program
+        # with the net-level bf16 precision override (activations
+        # compute in bfloat16 on the MXU's native 16-bit path) and casts
+        # the output blob back to f32 at the program boundary — scores
+        # stay f32 ndarrays for every caller. A dtype is fixed at
+        # construction, so the ladder still compiles exactly once per
+        # bucket: steady-state serving performs ZERO compiles either
+        # way.
+        if dtype not in ("", "f32", "bf16"):
+            raise ValueError(f"unknown serve_dtype {dtype!r} "
+                             "(expected 'f32' or 'bf16')")
+        self._precision = "" if dtype in ("", "f32") else dtype
         declared = self._declared_batch(self._base)
         self.max_batch = max_batch or declared
         self.ladder = plan_ladder(self.max_batch, ladder)
@@ -168,7 +180,7 @@ class BucketedForward:
                         if shape.dim:
                             shape.dim[0] = bucket
             net = Net(param, phase="TEST", model_dir=self._model_dir,
-                      device_transform=False)
+                      device_transform=False, precision=self._precision)
             if len(net.feed_blobs) != 1:
                 raise ValueError(
                     f"serving needs exactly one input blob, deploy net "
@@ -211,7 +223,14 @@ class BucketedForward:
 
             def fwd(p, s, feeds):
                 env, _, _ = net.apply(p, s, feeds, train=False)
-                return dict(env) if self._full_env else env[out]
+                if self._full_env:
+                    return dict(env)
+                res = env[out]
+                if res.dtype != np.float32:
+                    # bf16 bucket programs hand callers f32 scores — the
+                    # classify/detect row contract is dtype-stable
+                    res = res.astype(np.float32)
+                return res
 
             feeds_struct = {in_blob: jax.ShapeDtypeStruct(
                 net.blob_shapes[in_blob], np.float32)}
@@ -282,12 +301,13 @@ class InferenceModel:
                  *, ladder=None, max_batch: int = 0, mean=None,
                  input_scale=None, raw_scale=None, channel_swap=None,
                  image_dims=None, counter: CompileCounter | None = None,
-                 model_dir: str = ""):
+                 model_dir: str = "", dtype: str = "f32"):
         import jax
         self.name = name
         param = NetParameter.from_file(model_file)
         self.fwd = BucketedForward(param, ladder=ladder, max_batch=max_batch,
-                                   counter=counter, model_dir=model_dir)
+                                   counter=counter, model_dir=model_dir,
+                                   dtype=dtype)
         params, state = self.fwd.init()
         if weights:
             from .. import io as _io
@@ -391,6 +411,13 @@ class ServingEngine:
         self.hbm_budget = int(budget_mb * 2**20)  # 0 = unlimited
         self.ladder_spec = buckets if buckets is not None \
             else (sp.serve_buckets or None)
+        # serve_dtype (ISSUE 9): compute precision for every model's
+        # bucket programs; validated here like the other serving knobs
+        self.serve_dtype = str(getattr(sp, "serve_dtype", "") or "f32")
+        if self.serve_dtype not in ("f32", "bf16"):
+            raise ValueError(
+                f"unknown serve_dtype {self.serve_dtype!r} (expected "
+                "'f32' or 'bf16')")
         self.counter = CompileCounter()
         self._models: OrderedDict[str, InferenceModel] = OrderedDict()
         self._lock = threading.RLock()
@@ -417,7 +444,7 @@ class ServingEngine:
         steady-state traffic of any arrival-size mix runs zero compiles."""
         model = InferenceModel(
             name, model_file, weights, ladder=self.ladder_spec,
-            counter=self.counter, **preprocess)
+            counter=self.counter, dtype=self.serve_dtype, **preprocess)
         # count the incoming ladder on the warmed side BEFORE warming:
         # warm bumps the shared counter per bucket, and a /stats poll
         # mid-load must not read compile_count > warmed_buckets as a

@@ -44,6 +44,18 @@ log = logging.getLogger("caffe_mpi_tpu.solver")
 
 FeedFn = Callable[[int], dict]
 
+# dynamic loss-scale schedule (ISSUE 9): torch.amp GradScaler-shaped —
+# start high, halve on an overflow (skipped) step, double again after
+# `loss_scale_window` consecutive clean steps, clamped to [min, max].
+# The floor matters for the divergence policy: overflow skips only count
+# toward guard_max_skips once the scale can no longer back off, so a
+# recoverable overflow burst rescales instead of exiting 88.
+_LS_INIT = 2.0 ** 15
+_LS_MIN = 1.0
+_LS_MAX = 2.0 ** 24
+_LS_BACKOFF = 0.5
+_LS_GROWTH = 2.0
+
 
 def _load_net_param(sp: SolverParameter, phase: str, model_dir: str = "",
                     test_idx: int = 0) -> NetParameter:
@@ -102,6 +114,40 @@ class Solver:
         self.update_fn = UPDATE_FNS[self.type]
         self.rank = rank
 
+        # mixed-precision bf16 training (ISSUE 9, docs/benchmarks.md
+        # "Mixed-precision bf16 training"): "f32" (default) leaves every
+        # traced program bitwise-identical to a solver that predates the
+        # knob; "bf16" computes activations/gradients in bfloat16 with
+        # f32 MASTER params and momentum (updates in f32), and arms loss
+        # scaling — static (loss_scale > 0) folds into the existing
+        # global_grad_scale plumbing, dynamic (loss_scale 0) rides the
+        # guard carry (see _iteration_fn).
+        prec = str(getattr(sp, "precision", "") or "f32").lower()
+        if prec not in ("f32", "bf16"):
+            raise ValueError(
+                f"unknown precision {sp.precision!r} (expected 'f32' or "
+                "'bf16')")
+        self._precision = prec
+        ls = float(getattr(sp, "loss_scale", 0.0) or 0.0)
+        if ls < 0:
+            raise ValueError(
+                f"loss_scale must be >= 0 (0 = dynamic), got {ls}")
+        lsw = int(getattr(sp, "loss_scale_window", 0) or 0)
+        if lsw <= 0 and sp.has("loss_scale_window"):
+            raise ValueError(
+                f"loss_scale_window must be >= 1, got {lsw}")
+        self._ls_window = lsw if lsw > 0 else 200
+        # dynamic scaling is a bf16 mechanism: bf16 keeps f32's exponent
+        # range, but the SCALED f32 loss/cotangents can still overflow,
+        # and the skip+rescale loop is the torch-amp recovery contract
+        self._dyn_scale = prec == "bf16" and ls == 0
+        self._static_scale = ls if (prec == "bf16" and ls > 0) else 1.0
+        if prec == "bf16" and gpipe:
+            raise ValueError(
+                "precision: bf16 is unsupported under gpipe (stage-local "
+                "updates bypass the loss-scaling carry); use the mesh "
+                "path")
+
         self.model_dir = model_dir
         # gpipe micro-batching follows the reference's divide_batch
         # semantics (parallel.cpp:295-348): the prototxt batch is the
@@ -125,7 +171,7 @@ class Solver:
             batch_divisor=batch_divisor, data_shape_probe=data_shape_probe,
             model_dir=model_dir, level=tstate.level if tstate else 0,
             stages=tuple(tstate.stage) if tstate else (),
-            solver_storage=sp.solver_data_type)
+            solver_storage=sp.solver_data_type, precision=self._precision)
         self.net = Net(train_param, phase="TRAIN", **self._net_ctor)
         self.test_nets: list[Net] = []
         n_tests = max(len(sp.test_net), len(sp.test_net_param),
@@ -136,7 +182,8 @@ class Solver:
             self.test_nets.append(Net(tp, phase="TEST", model_dir=model_dir,
                                       data_shape_probe=data_shape_probe,
                                       level=ts.level if ts else 0,
-                                      stages=tuple(ts.stage) if ts else ()))
+                                      stages=tuple(ts.stage) if ts else (),
+                                      precision=self._precision))
 
         seed = sp.random_seed if sp.random_seed >= 0 else 0
         self.base_rng = jax.random.PRNGKey(seed)
@@ -278,7 +325,14 @@ class Solver:
         # just launched. skipped_steps / guard_sync_count are the
         # CPU-visible telemetry bench.py reports (the "guard is ~free"
         # claim is measured, not asserted).
-        self._guard_on = bool(getattr(sp, "train_guard", False))
+        # dynamic loss scaling (ISSUE 9) reuses the guard machinery: the
+        # skip-step select is how an overflowed step is discarded, and
+        # the scale/clean-window counters ride the same carry — so a
+        # bf16 run with loss_scale 0 arms the guard even when the
+        # prototxt never asked for train_guard (there is no bitwise
+        # claim to protect on the bf16 path)
+        self._guard_on = bool(getattr(sp, "train_guard", False)) \
+            or self._dyn_scale
         if self._guard_on and self._gpipe_cfg is not None:
             raise ValueError(
                 "train_guard is unsupported under gpipe (the guard "
@@ -289,6 +343,13 @@ class Solver:
         self._guard_unchecked = 0
         self.skipped_steps = 0
         self.guard_sync_count = 0
+        # ISSUE 9 telemetry (host mirrors of the carried scale state,
+        # refreshed at guard checks): overflow_steps counts skipped
+        # steps attributed to loss-scale overflow; loss_scale_value is
+        # the last materialized dynamic scale (or the static one)
+        self.overflow_steps = 0
+        self.loss_scale_value = (_LS_INIT if self._dyn_scale
+                                 else float(self._static_scale))
         self._fault_feed_cache: tuple | None = None
         self._grad_transform = grad_transform
         # decls (lr_mult/decay_mult per param) in pytree-congruent form
@@ -473,13 +534,18 @@ class Solver:
             n_buckets = train_param.reduce_buckets
         self._reduction = reduction.plan_for_net(
             self.net, self.params, n_buckets=n_buckets,
-            bucket_bytes=int(bucket_mb * (1 << 20)), n_data=n_data)
+            bucket_bytes=int(bucket_mb * (1 << 20)), n_data=n_data,
+            # ISSUE 9: under precision bf16 the buckets pack and psum in
+            # bf16 — collective bytes halve; the post-psum 1/n scale and
+            # everything downstream run in f32
+            wire_dtype="bfloat16" if self._precision == "bf16" else None)
         if self.rank == 0:
             log.info(
                 "overlapped bucketed reduction: %d bucket(s) over "
-                "'data'=%d, bytes per bucket %s",
+                "'data'=%d, bytes per bucket %s%s",
                 len(self._reduction.buckets), n_data,
-                list(self._reduction.bucket_bytes))
+                list(self._reduction.bucket_bytes),
+                " (bf16 wire)" if self._precision == "bf16" else "")
 
     def reduction_stats(self) -> dict | None:
         """Gradient-reduction telemetry for bench.py / the MULTICHIP
@@ -553,43 +619,64 @@ class Solver:
         update_fn = self.update_fn
         if self.type == "RMSProp":
             update_fn = partial(update_fn, rms_decay=sp.rms_decay)
+        # static bf16 loss scale (ISSUE 9, loss_scale > 0) folds into the
+        # existing global_grad_scale plumbing: loss scaled up before the
+        # bf16 backward, grads unwound by the same factor in f32. The
+        # f32 path multiplies by exactly 1.0 (python float), so its
+        # traced program is unchanged.
         grad_scale = sp.global_grad_scale if sp.global_grad_scale else 1.0
+        grad_scale = grad_scale * self._static_scale
         iter_size = max(sp.iter_size, 1)
         grad_transform = self._grad_transform
         guard = self._guard_on
+        dyn = self._dyn_scale
+        ls_window = self._ls_window
         spike = float(getattr(sp, "guard_loss_spike", 0.0) or 0.0)
         ema_decay = float(getattr(sp, "guard_ema_decay", 0.9) or 0.9)
-
-        def loss_fn(params, net_state, feeds, rng):
-            blobs, new_state, loss = net.apply(params, net_state, feeds,
-                                               train=True, rng=rng)
-            return loss * grad_scale, (new_state, loss)
-
-        # gradient routine: plain whole-tree value_and_grad (GSPMD
-        # inserts and places the all-reduces), or — when the bucketed
-        # reduction plan is active (ISSUE 6) — the shard_map variant
-        # that psums each reverse-topo bucket explicitly so the TPU
-        # scheduler can overlap the collectives with remaining
-        # backward. Its loss_fn closes over the batch/n shadow net
-        # (divide_batch_size, parallel.cpp:295-348): each device
-        # differentiates its local shard.
-        if self._reduction is not None:
+        reduction_plan = self._reduction
+        lnet = self._reduction_net
+        mesh = self.mesh
+        if reduction_plan is not None:
             from ..parallel import reduction as _reduction
-            lnet = self._reduction_net
 
-            def local_loss_fn(params, net_state, feeds, rng):
-                blobs, new_state, loss = lnet.apply(
-                    params, net_state, feeds, train=True, rng=rng)
-                return loss * grad_scale, (new_state, loss)
+        def make_value_and_grad(eff_scale):
+            """Gradient routine for one effective loss scale — plain
+            whole-tree value_and_grad (GSPMD inserts and places the
+            all-reduces), or — when the bucketed reduction plan is
+            active (ISSUE 6) — the shard_map variant that psums each
+            reverse-topo bucket explicitly so the TPU scheduler can
+            overlap the collectives with remaining backward. Its
+            loss_fn closes over the batch/n shadow net
+            (divide_batch_size, parallel.cpp:295-348): each device
+            differentiates its local shard. Built inside the step body
+            because under DYNAMIC loss scaling (ISSUE 9) eff_scale is a
+            traced scalar read from the guard carry; on the static/f32
+            path it is the same python float as ever, so the traced
+            program is identical."""
+            def loss_fn(params, net_state, feeds, rng):
+                blobs, new_state, loss = net.apply(params, net_state, feeds,
+                                                   train=True, rng=rng)
+                return loss * eff_scale, (new_state, loss)
 
-            value_and_grad = _reduction.bucketed_value_and_grad(
-                local_loss_fn, self.mesh, self._reduction)
-        else:
-            value_and_grad = jax.value_and_grad(loss_fn, has_aux=True)
+            if reduction_plan is not None:
+                def local_loss_fn(params, net_state, feeds, rng):
+                    blobs, new_state, loss = lnet.apply(
+                        params, net_state, feeds, train=True, rng=rng)
+                    return loss * eff_scale, (new_state, loss)
+
+                return _reduction.bucketed_value_and_grad(
+                    local_loss_fn, mesh, reduction_plan)
+            return jax.value_and_grad(loss_fn, has_aux=True)
 
         def step(params, net_state, opt_state, feeds_stack, it, rng,
                  gstate=None):
             net_state0 = net_state
+            # dynamic loss scaling: the scale is part of the guard carry
+            # — every micro-batch of this step backwards through the
+            # carried scale, and the guard's skip decision below is what
+            # discards an overflowed step and backs the scale off
+            eff_scale = grad_scale * gstate["scale"] if dyn else grad_scale
+            value_and_grad = make_value_and_grad(eff_scale)
             # iter_size accumulation: feeds_stack pytree has leading
             # iter_size dim on every leaf (solver.cpp:277-288)
             def micro(carry, feeds_rng):
@@ -613,9 +700,11 @@ class Solver:
                 ((grads, total_loss), net_state), _ = jax.lax.scan(
                     micro, ((zero_g, jnp.float32(0.0)), net_state),
                     (feeds_stack, rngs))
-            # normalize: 1/(iter_size * grad_scale) (SGDSolver::Normalize +
-            # net.cpp:815-818 loss-scale unwind)
-            denom = iter_size * grad_scale
+            # normalize: 1/(iter_size * loss scale) (SGDSolver::Normalize
+            # + net.cpp:815-818 loss-scale unwind) — the unwind happens
+            # AFTER the cast to f32, so a dynamically-scaled bf16
+            # gradient re-enters master range without double rounding
+            denom = iter_size * eff_scale
             grads = jax.tree.map(lambda g: g.astype(jnp.float32) / denom, grads)
             loss_out = total_loss / iter_size
 
@@ -703,16 +792,21 @@ class Solver:
             def _apply_guard(op):
                 (loss_b, newp, newo, news, oldp, oldo, olds, gs,
                  it_b) = op
-                ok = jnp.isfinite(loss_b)
+                ok_fin = jnp.isfinite(loss_b)
                 for leaf in jax.tree.leaves((newp, newo, news)):
                     if hasattr(leaf, "dtype") and jnp.issubdtype(
                             leaf.dtype, jnp.floating):
-                        ok = jnp.logical_and(ok,
-                                             jnp.all(jnp.isfinite(leaf)))
+                        ok_fin = jnp.logical_and(
+                            ok_fin, jnp.all(jnp.isfinite(leaf)))
+                ok = ok_fin
                 if spike > 0:
                     # EMA < 0 = "no accepted loss yet": never spikes. A
                     # NaN loss compares False, so the finite check and
                     # the spike check agree on non-finite steps.
+                    # ok_fin stays separate: under dynamic loss scaling
+                    # only a NON-FINITE skip is an overflow the scale
+                    # schedule should react to — a finite loss spike is
+                    # a real anomaly, not a scaling artifact.
                     ok = jnp.logical_and(ok, jnp.where(
                         gs["ema"] >= 0, loss_b <= spike * gs["ema"],
                         True))
@@ -723,8 +817,24 @@ class Solver:
                 # unguarded schedule.
                 keep = lambda n, o: jnp.where(ok, n, o)
                 ema = gs["ema"]
-                consec = jnp.where(ok, 0, gs["consec"] + 1).astype(
-                    jnp.int32)
+                if dyn:
+                    # ISSUE 9: an OVERFLOW skip (non-finite) under
+                    # dynamic loss scaling is a RECOVERABLE event — the
+                    # scale backs off and the run continues — so it only
+                    # feeds the guard_max_skips divergence counter once
+                    # the scale is already at its floor and can no
+                    # longer help. A finite SPIKE skip is a genuine
+                    # anomaly (no scale change could have caused it) and
+                    # counts immediately, like guard-only mode.
+                    overflow = jnp.logical_not(ok_fin)
+                    at_floor = gs["scale"] <= _LS_MIN
+                    counts = jnp.where(overflow, at_floor, True)
+                    consec = jnp.where(
+                        ok, 0, jnp.where(counts, gs["consec"] + 1,
+                                         0)).astype(jnp.int32)
+                else:
+                    consec = jnp.where(ok, 0, gs["consec"] + 1).astype(
+                        jnp.int32)
                 new_gs = {
                     "skips": gs["skips"] + jnp.where(ok, 0, 1).astype(
                         jnp.int32),
@@ -748,6 +858,29 @@ class Solver:
                                       loss_b),
                         ema).astype(jnp.float32),
                 }
+                if dyn:
+                    # loss-scale schedule (ISSUE 9): halve on OVERFLOW
+                    # (non-finite) skips only — a finite spike skip
+                    # leaves the scale alone (halving real gradients
+                    # toward underflow would not address it) — and grow
+                    # 2x after ls_window consecutive clean steps;
+                    # `good` is the clean-step counter, reset by both a
+                    # growth event and any skip
+                    good = jnp.where(ok, gs["good"] + 1, 0).astype(
+                        jnp.int32)
+                    grow = jnp.logical_and(ok, good >= ls_window)
+                    scale = jnp.where(
+                        grow,
+                        jnp.minimum(gs["scale"] * _LS_GROWTH, _LS_MAX),
+                        jnp.where(overflow,
+                                  jnp.maximum(gs["scale"] * _LS_BACKOFF,
+                                              _LS_MIN), gs["scale"]))
+                    new_gs["scale"] = scale.astype(jnp.float32)
+                    new_gs["good"] = jnp.where(grow, 0, good).astype(
+                        jnp.int32)
+                    new_gs["overflows"] = (
+                        gs["overflows"] + jnp.where(overflow, 1,
+                                                    0)).astype(jnp.int32)
                 return (jax.tree.map(keep, newp, oldp),
                         jax.tree.map(keep, news, olds),
                         jax.tree.map(keep, newo, oldo), new_gs)
@@ -755,14 +888,22 @@ class Solver:
             def _all_skip(op):  # unreachable (it >= 0 always)
                 (_loss_b, _newp, _newo, _news, oldp, oldo, olds, gs,
                  it_b) = op
-                return (oldp, olds, oldo, {
+                out_gs = {
                     "skips": gs["skips"] + 1,
                     "consec": gs["consec"] + 1,
                     "max_consec": jnp.maximum(gs["max_consec"],
                                               gs["consec"] + 1),
                     "last_bad": it_b,
                     "ema": gs["ema"],
-                })
+                }
+                if dyn:
+                    out_gs["scale"] = jnp.maximum(
+                        gs["scale"] * _LS_BACKOFF, _LS_MIN).astype(
+                            jnp.float32)
+                    out_gs["good"] = jnp.int32(0)
+                    out_gs["overflows"] = (gs["overflows"] + 1).astype(
+                        jnp.int32)
+                return (oldp, olds, oldo, out_gs)
 
             new_params, net_state, new_opt, new_gstate = jax.lax.cond(
                 it >= 0, _apply_guard, _all_skip,
@@ -1084,6 +1225,13 @@ class Solver:
         gs = {"skips": jnp.int32(0), "consec": jnp.int32(0),
               "max_consec": jnp.int32(0),
               "last_bad": jnp.int32(-1), "ema": jnp.float32(-1.0)}
+        if self._dyn_scale:
+            # ISSUE 9: the dynamic loss scale and its clean-step /
+            # overflow counters ride the same carry — zero extra
+            # dispatches, and the scale-down decision never leaves HBM
+            gs["scale"] = jnp.float32(_LS_INIT)
+            gs["good"] = jnp.int32(0)
+            gs["overflows"] = jnp.int32(0)
         if self.mesh is not None:
             gs = self.mesh.replicate(gs)
         return gs
@@ -1111,6 +1259,18 @@ class Solver:
         skips = int(vals["skips"])
         last_bad = int(vals["last_bad"])
         self.guard_sync_count += 1
+        if "scale" in vals:
+            # ISSUE 9: dynamic loss-scale telemetry rides the same
+            # 5(+3)-scalar transfer — no extra host traffic
+            overflows = int(vals["overflows"])
+            scale = float(vals["scale"])
+            if overflows > self.overflow_steps and self.rank == 0:
+                log.warning(
+                    "loss scale: %d overflow step(s) so far (+%d this "
+                    "chunk), skipped and rescaled — scale now %g",
+                    overflows, overflows - self.overflow_steps, scale)
+            self.overflow_steps = overflows
+            self.loss_scale_value = scale
         if skips > self.skipped_steps and self.rank == 0:
             log.warning(
                 "train guard: %d skipped step(s) so far (+%d this chunk, "
@@ -1119,10 +1279,17 @@ class Solver:
         self.skipped_steps = skips
         m = int(getattr(self.sp, "guard_max_skips", 0) or 0)
         if m > 0 and consec >= m:
+            extra = {}
+            if "scale" in vals:
+                # under dynamic scaling this only trips once the scale
+                # sat at its floor for m consecutive skips: a genuine
+                # divergence, not an overflow the schedule could absorb
+                extra = {"loss_scale": float(vals["scale"]),
+                         "overflow_steps": int(vals["overflows"])}
             self._journal_run_state(
                 "numeric_anomaly", consec_skips=consec,
                 skipped_steps=skips, last_bad_iter=last_bad,
-                exit_code=resilience.EXIT_NUMERIC)
+                exit_code=resilience.EXIT_NUMERIC, **extra)
             raise resilience.NumericAnomalyError(
                 boundary_iter, consec, skips, last_bad)
 

@@ -105,6 +105,31 @@ def _parser() -> argparse.ArgumentParser:
                    "grad_bucket_mb; a single param above the budget "
                    "gets its own bucket with a warning; exclusive of "
                    "-reduce_buckets)")
+    # mixed-precision flags (ISSUE 9, docs/benchmarks.md
+    # "Mixed-precision bf16 training")
+    p.add_argument("-precision", "--precision", default="",
+                   choices=["", "f32", "bf16"],
+                   help="train: compute precision (overrides solver "
+                   "precision; '' = prototxt value, default f32 = "
+                   "bitwise today). bf16 computes activations/gradients "
+                   "in bfloat16 with f32 MASTER params and momentum — "
+                   "updates in f32, reduce_overlap buckets psum in bf16 "
+                   "(half the collective bytes), loss scaling armed per "
+                   "-loss_scale")
+    p.add_argument("-loss_scale", "--loss-scale", dest="loss_scale",
+                   type=float, default=-1.0,
+                   help="bf16 loss scale: 0 = DYNAMIC (scale rides the "
+                   "train-scan carry; an overflow step is skipped and "
+                   "the scale halves instead of exiting 88, regrowing "
+                   "2x after loss_scale_window clean steps); > 0 = that "
+                   "static scale (overrides solver loss_scale; -1 = "
+                   "prototxt value, which defaults to dynamic). "
+                   "Consumed only under -precision bf16")
+    p.add_argument("-loss_scale_window", "--loss-scale-window",
+                   dest="loss_scale_window", type=int, default=0,
+                   help="clean steps before the dynamic loss scale "
+                   "grows 2x (overrides solver loss_scale_window; 0 = "
+                   "prototxt value, which defaults to 200)")
     # survivable-training flags (ISSUE 3, utils/resilience.py)
     p.add_argument("-resume", "--resume", default="",
                    help="'auto' = resume from the newest VERIFIED "
@@ -202,6 +227,14 @@ def _parser() -> argparse.ArgumentParser:
                    "spills to its host master copy when exceeded "
                    "(overrides ServingParameter serve_hbm_mb; -1 = "
                    "schema default 0 = unlimited)")
+    p.add_argument("-serve_dtype", "--serve-dtype", dest="serve_dtype",
+                   default="", choices=["", "f32", "bf16"],
+                   help="serve: bucket-program compute precision "
+                   "(overrides ServingParameter serve_dtype; '' = "
+                   "schema default f32). bf16 runs every bucket forward "
+                   "in bfloat16 and casts scores back to f32 — the "
+                   "ladder still AOT-compiles once per bucket, zero "
+                   "steady-state compiles either way")
     p.add_argument("-smoke", "--smoke", type=int, default=0,
                    help="serve: self-test — serve N synthetic requests "
                    "of mixed sizes over real HTTP, print the telemetry "
@@ -383,6 +416,13 @@ def cmd_train(args) -> int:
         if reduction.apply_tpu_overlap_flags(os.environ):
             log.info("TPU overlap flags appended to LIBTPU_INIT_ARGS: %s",
                      " ".join(reduction.tpu_overlap_flags()))
+    if args.precision:
+        sp.precision = args.precision
+    if args.loss_scale >= 0:
+        # 0 is meaningful (dynamic scaling); -1 = prototxt
+        sp.loss_scale = args.loss_scale
+    if args.loss_scale_window:
+        sp.loss_scale_window = args.loss_scale_window
     if args.train_guard:
         sp.train_guard = True
     if args.guard_max_skips >= 0:
@@ -734,6 +774,8 @@ def cmd_serve(args) -> int:
         sp.serve_buckets = args.serve_buckets
     if args.serve_hbm_mb >= 0:
         sp.serve_hbm_mb = args.serve_hbm_mb
+    if args.serve_dtype:
+        sp.serve_dtype = args.serve_dtype
     engine = ServingEngine(sp)
     engine.load_model("default", args.model, args.weights or None)
     srv = make_server(engine, "default", labels=args.labels or None,

@@ -51,6 +51,10 @@ KNOBS = (
     "serve_window_ms",  # ISSUE 7: continuous-batching window
     "serve_buckets",    # ISSUE 7: AOT padded-batch bucket ladder
     "serve_hbm_mb",     # ISSUE 7: resident-model HBM budget (LRU spill)
+    "precision",        # ISSUE 9: bf16 compute, f32 master weights
+    "loss_scale",       # ISSUE 9: static/dynamic bf16 loss scaling
+    "loss_scale_window",  # ISSUE 9: clean steps before scale regrowth
+    "serve_dtype",      # ISSUE 9: bf16 serving bucket programs
 )
 
 CONFIG_FILE = os.path.join("caffe_mpi_tpu", "proto", "config.py")

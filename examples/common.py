@@ -77,6 +77,8 @@ def run_example(here: str, artifacts: list[str], create_main,
     import logging
     accs: list[float] = []
     handler = None
+    solver_log = logging.getLogger("caffe_mpi_tpu.solver")
+    prev_level = solver_log.level
     if expect_acc and not have_real:
         class _CaptureScores(logging.Handler):
             def emit(self, rec):
@@ -86,12 +88,21 @@ def run_example(here: str, artifacts: list[str], create_main,
                 if a and len(a) == 3 and a[1] == "accuracy":
                     accs.append(float(a[2]))
         handler = _CaptureScores()
-        logging.getLogger("caffe_mpi_tpu.solver").addHandler(handler)
+        solver_log.addHandler(handler)
+        # pin the logger's own level: cli.main's basicConfig is a NO-OP
+        # when a host process (pytest) already configured the root
+        # logger, leaving the effective level at WARNING — the INFO
+        # score lines were then filtered before this handler ever ran,
+        # and the self-assert reported "no test evaluation ran" even
+        # though evaluation DID run (the standing mnist/finetune
+        # failure since seed)
+        solver_log.setLevel(logging.INFO)
     try:
         rc = caffe_main(cli)
     finally:
         if handler is not None:
-            logging.getLogger("caffe_mpi_tpu.solver").removeHandler(handler)
+            solver_log.removeHandler(handler)
+            solver_log.setLevel(prev_level)
     if rc == 0 and handler is not None:
         from caffe_mpi_tpu.proto import SolverParameter
         ran = args.max_iter or SolverParameter.from_file(

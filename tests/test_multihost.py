@@ -73,6 +73,14 @@ def _run_cluster(tmp_path, mode):
                 q.kill()
             pytest.fail("multi-host worker timed out")
         logs.append(stdout)
+    if any("Multiprocess computations aren't implemented" in l
+           for l in logs):
+        # this jaxlib's CPU backend cannot form a cross-process
+        # computation at all (jax.distributed connects, but the first
+        # collective device_put raises) — the test is unrunnable here,
+        # not failing. Real multi-host coverage needs a TPU slice.
+        pytest.skip("backend cannot run multiprocess computations "
+                    "(CPU); multi-host DP needs real devices")
     for i, (p, l) in enumerate(zip(procs, logs)):
         assert p.returncode == 0, f"proc {i} failed:\n{l[-3000:]}"
     return out

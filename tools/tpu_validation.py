@@ -7,7 +7,9 @@ rest:
   2. bench.py              -> headline img/s + MFU JSON line
   3. TPU-marked pytest     -> flash-attention Mosaic compile fwd+bwd
   4. caffe time alexnet    -> per-layer + fused timings + MFU
-  5. short `caffe train -gpu all` on synthetic lenet shapes
+  5. short `caffe train -gpu all` on synthetic lenet shapes (plus the
+     ISSUE 9 `-precision bf16` variant: bf16 MXU compute, f32 master
+     weights, dynamic loss scaling — 0 overflow skips expected)
   6. `caffe serve -smoke` — the inference serving plane (ISSUE 7) on
      real hardware: AOT bucket warm, continuous batching over real
      HTTP, zero post-warmup compiles asserted, p50/p99 + img/s printed
@@ -138,6 +140,19 @@ for causal in (False, True):
                  "-solver", "models/lenet/lenet_solver.prototxt",
                  "-synthetic", "-max_iter", "200", "-gpu", "all",
                  "-snapshot_prefix", "/tmp/caffe_tpu_val/lenet"],
+                600, log)
+            # mixed-precision bf16 training on real hardware (ISSUE 9):
+            # bf16 activations/gradients on the MXU's native 16-bit
+            # path, f32 master weights, dynamic loss scaling riding the
+            # scan carry (Pallas LRN kernels engage on LRN nets; lenet
+            # has none — bench.py's bf16 block covers AlexNet). The
+            # run must finish with 0 overflow skips on synthetic data.
+            run("train-bf16",
+                [py, "-m", "caffe_mpi_tpu.tools.cli", "train",
+                 "-solver", "models/lenet/lenet_solver.prototxt",
+                 "-synthetic", "-max_iter", "200", "-gpu", "all",
+                 "-precision", "bf16",
+                 "-snapshot_prefix", "/tmp/caffe_tpu_val/lenet_bf16"],
                 600, log)
             # overlapped bucketed reduction surface on real hardware
             # (ISSUE 6, parallel/reduction.py): exercises the CLI

@@ -100,6 +100,17 @@ BF16 = os.environ.get("CAFFE_BENCH_BF16", "1") != "0"
 # headline metric itself is untouched (separate process, untimed).
 SERVING = os.environ.get("CAFFE_BENCH_SERVING", "1") != "0"
 SERVING_DEADLINE_S = 180
+# CAFFE_BENCH_INGEST: the host-ingestion telemetry block (ISSUE 10,
+# native/decode.cc + data/decode.py — docs/benchmarks.md "Ingestion").
+# Default ON: the parent runs `bench_data --ingest-only --json` in its
+# own watched subprocess (CPU-only, no jax import, so a dead tunnel
+# cannot touch it) and attaches the `ingest` JSON — per-stage ms/batch
+# (read/crc/decode/transform/assemble over a JPEG-encoded LMDB), the
+# PIL-vs-native-fused img/s A/B, and the decoded-cache epoch-2 rate —
+# to the emitted line on every path, headline success or not. The
+# headline metric itself is untouched (separate process, untimed).
+INGEST = os.environ.get("CAFFE_BENCH_INGEST", "1") != "0"
+INGEST_DEADLINE_S = 240
 _SOLVERS = {
     ("alexnet", "f32"): "models/alexnet/solver.prototxt",
     ("alexnet", "bf16"): "models/alexnet/solver_fp16.prototxt",
@@ -362,6 +373,30 @@ def serving_block():
                       else f"serving bench exited rc={r.returncode}")}
 
 
+def ingest_block():
+    """Run the ingestion bench in a watched child; returns the `ingest`
+    dict (or {"error": ...}). CPU work only — safe with the tunnel
+    down; this is exactly the host-side evidence the tunnel-dead rounds
+    were missing."""
+    cmd = [sys.executable, "-m", "caffe_mpi_tpu.tools.bench_data",
+           "--ingest-only", "--json", "--ingest-n", "768",
+           "-batch", "128"]
+    try:
+        r = subprocess.run(cmd, text=True, capture_output=True, cwd=_ROOT,
+                           timeout=INGEST_DEADLINE_S)
+    except subprocess.TimeoutExpired:
+        return {"error": f"ingest bench exceeded {INGEST_DEADLINE_S}s"}
+    for line in reversed(r.stdout.strip().splitlines() or [""]):
+        if line.startswith("{"):
+            try:
+                return json.loads(line)["ingest"]
+            except (ValueError, KeyError):
+                break
+    tail = [l for l in r.stderr.strip().splitlines() if l.strip()]
+    return {"error": (tail[-1][-300:] if tail
+                      else f"ingest bench exited rc={r.returncode}")}
+
+
 def _attempt(deadline_s):
     """Run the bench body in a watched child; return (json_line|None, err)."""
     env = dict(os.environ, CAFFE_TPU_BENCH_CHILD="1")
@@ -385,19 +420,24 @@ if __name__ == "__main__":
         emit(value, vs, extra)
         sys.exit(0)
 
-    # the budget clock starts BEFORE the serving bench: its subprocess
-    # deadline spends the same total wall budget the docstring promises,
-    # instead of extending it by up to SERVING_DEADLINE_S
+    # the budget clock starts BEFORE the serving/ingest benches: their
+    # subprocess deadlines spend the same total wall budget the
+    # docstring promises, instead of extending it
     start = time.monotonic()
-    # serving telemetry first (CPU-only, own subprocess): it must ride
-    # the emitted line on every path, device success, failure, or dead
-    # tunnel — the zero-recompile claim is CPU-visible by design
-    serving = serving_block() if SERVING else None
-    extra_serving = {"serving": serving} if serving is not None else None
+    # CPU-only telemetry first (own subprocesses): it must ride the
+    # emitted line on every path, device success, failure, or dead
+    # tunnel — the zero-recompile and ingestion claims are CPU-visible
+    # by design
+    telemetry = {}
+    if SERVING:
+        telemetry["serving"] = serving_block()
+    if INGEST:
+        telemetry["ingest"] = ingest_block()
+    telemetry = telemetry or None
 
     err = probe()
     if err:
-        emit(error=err, extra=extra_serving)
+        emit(error=err, extra=telemetry)
         sys.exit(0)
 
     last_err = "unknown"
@@ -416,14 +456,14 @@ if __name__ == "__main__":
             break
         line, last_err = _attempt(remaining)
         if line is not None:
-            if serving is not None:
+            if telemetry is not None:
                 try:
                     obj = json.loads(line)
-                    obj["serving"] = serving
+                    obj.update(telemetry)
                     line = json.dumps(obj)
                 except ValueError:
                     pass  # never let telemetry mangle the headline line
             print(line)
             sys.exit(0)
-    emit(error=last_err, extra=extra_serving)
+    emit(error=last_err, extra=telemetry)
     sys.exit(0)

@@ -20,7 +20,8 @@ from __future__ import annotations
 
 import os
 import struct
-from typing import Protocol
+import threading
+from typing import NamedTuple, Protocol
 
 import numpy as np
 
@@ -53,7 +54,22 @@ def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
         shift += 7
 
 
-def parse_datum(buf: bytes) -> tuple[np.ndarray, int]:
+class DatumFields(NamedTuple):
+    """Parsed-but-unmaterialized Datum: the wire fields with the image
+    payload still in its stored form. The fused native ingestion path
+    (feeder._build_batch_fused) consumes `data` bytes of encoded records
+    directly — one ctypes call decodes a whole batch — while `get()`
+    callers materialize per record via `materialize_datum`."""
+    channels: int
+    height: int
+    width: int
+    data: bytes
+    label: int
+    encoded: bool
+    float_data: list[float]
+
+
+def parse_datum_fields(buf: bytes) -> DatumFields:
     """Minimal protobuf-wire Datum parser (no protoc dependency)."""
     channels = height = width = label = 0
     data = b""
@@ -92,18 +108,30 @@ def parse_datum(buf: bytes) -> tuple[np.ndarray, int]:
             pos += 8
         else:
             raise ValueError(f"unsupported wire type {wire}")
-    if encoded:
-        import io
-        from PIL import Image
-        img = np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
-        # PIL gives RGB HWC; Caffe stores BGR — convert for parity with
-        # the reference's OpenCV decode (io.cpp DecodeDatumToCVMat)
-        arr = img[:, :, ::-1].transpose(2, 0, 1)
-    elif data:
-        arr = np.frombuffer(data, np.uint8).reshape(channels, height, width)
+    return DatumFields(channels, height, width, data, label, encoded,
+                       float_data)
+
+
+def materialize_datum(f: DatumFields) -> tuple[np.ndarray, int]:
+    """DatumFields -> (CHW array, label); encoded payloads route through
+    the decode plane (data/decode.py: native libjpeg/libpng when
+    enabled, PIL fallback — BGR CHW parity with the reference's OpenCV
+    decode either way)."""
+    if f.encoded:
+        from .decode import decode_image
+        arr = decode_image(f.data)
+    elif f.data:
+        arr = np.frombuffer(f.data, np.uint8).reshape(
+            f.channels, f.height, f.width)
     else:
-        arr = np.asarray(float_data, np.float32).reshape(channels, height, width)
-    return arr, label
+        arr = np.asarray(f.float_data, np.float32).reshape(
+            f.channels, f.height, f.width)
+    return arr, f.label
+
+
+def parse_datum(buf: bytes) -> tuple[np.ndarray, int]:
+    """Datum wire bytes -> (CHW array, label)."""
+    return materialize_datum(parse_datum_fields(buf))
 
 
 def _datum_header(c: int, h: int, w: int) -> bytearray:
@@ -122,6 +150,36 @@ def encode_datum(arr: np.ndarray, label: int) -> bytes:
     out += _dfield(4, 2) + _dvarint(len(raw)) + raw
     out += _dfield(5, 0) + _dvarint(label if label >= 0
                                     else label + (1 << 64))
+    return bytes(out)
+
+
+def encode_datum_image(arr: np.ndarray, label: int, codec: str = "jpeg",
+                       quality: int = 95) -> bytes:
+    """Datum carrying an ENCODED image (field 7 = true, data = JPEG/PNG
+    bytes) — the reference's `convert_imageset -encoded` path
+    (io.cpp EncodeDatum / tools/convert_imageset.cpp encode_type).
+    `arr` is BGR CHW uint8, matching what parse_datum returns."""
+    import io as _io
+
+    from PIL import Image
+    c, h, w = arr.shape
+    if c != 3:
+        raise ValueError("encoded datums are 3-channel BGR")
+    rgb = np.ascontiguousarray(
+        arr.astype(np.uint8)[::-1].transpose(1, 2, 0))  # BGR CHW -> RGB HWC
+    buf = _io.BytesIO()
+    if codec.lower() in ("jpeg", "jpg"):
+        Image.fromarray(rgb).save(buf, "JPEG", quality=quality)
+    elif codec.lower() == "png":
+        Image.fromarray(rgb).save(buf, "PNG")
+    else:
+        raise ValueError(f"unknown codec {codec!r}")
+    raw = buf.getvalue()
+    out = _datum_header(c, h, w)
+    out += _dfield(4, 2) + _dvarint(len(raw)) + raw
+    out += _dfield(5, 0) + _dvarint(label if label >= 0
+                                    else label + (1 << 64))
+    out += _dfield(7, 0) + _dvarint(1)
     return bytes(out)
 
 
@@ -144,7 +202,8 @@ def encode_datum_float(arr: np.ndarray, label: int) -> bytes:
 
 def _decode_verified(raw: bytes, index: int, source: str,
                      expect_crc: int | None = None,
-                     actual_crc: int | None = None):
+                     actual_crc: int | None = None, *,
+                     fields: bool = False):
     """Datum decode with integrity verification. `expect_crc` (from the
     LMDB crc sidecar / a format-level checksum) is compared against
     `actual_crc` — computed here over the fetched bytes when the caller
@@ -169,7 +228,11 @@ def _decode_verified(raw: bytes, index: int, source: str,
                 f"crc32c mismatch (sidecar {expect_crc:08x}, "
                 f"computed {actual_crc:08x})")
     try:
-        return parse_datum(raw)
+        f = parse_datum_fields(raw)
+        # fields=True defers image decode to the caller (the fused
+        # native batch path); decode failures there re-enter the
+        # quarantine plane through the per-record get() fallback
+        return f if fields else materialize_datum(f)
     except Exception as e:
         raise RecordIntegrityError(
             source, index, f"undecodable Datum: {e!r}") from e
@@ -238,6 +301,16 @@ class LMDBDataset:
         return len(self.keys)
 
     def get(self, index: int) -> tuple[np.ndarray, int]:
+        return self._get(index, fields=False)
+
+    def get_datum(self, index: int) -> DatumFields:
+        """Verified wire fields WITHOUT materializing the image — the
+        fused native ingestion path decodes encoded payloads batch-at-
+        a-time (feeder._build_batch_fused). crc/structural verification
+        is identical to get()."""
+        return self._get(index, fields=True)
+
+    def _get(self, index: int, fields: bool):
         expect = int(self._crcs[index]) if self._crcs is not None else None
         if self._native is not None:
             raw = self._native.value(index)
@@ -249,7 +322,8 @@ class LMDBDataset:
                       if expect is not None and not FAULTS.active(
                           "record_corrupt")
                       and not FAULTS.active("record_decode") else None)
-            return _decode_verified(raw, index, self.path, expect, actual)
+            return _decode_verified(raw, index, self.path, expect, actual,
+                                    fields=fields)
         try:
             if self._reader is not None:
                 raw = self._reader.get(self.keys[index])
@@ -261,7 +335,8 @@ class LMDBDataset:
             # quarantine signal as a checksum mismatch
             raise RecordIntegrityError(self.path, index,
                                        f"structural: {e}") from e
-        return _decode_verified(raw, index, self.path, expect)
+        return _decode_verified(raw, index, self.path, expect,
+                                fields=fields)
 
 
 class LevelDBDataset:
@@ -285,6 +360,14 @@ class LevelDBDataset:
         return len(self._reader)
 
     def get(self, index: int) -> tuple[np.ndarray, int]:
+        return self._get(index, fields=False)
+
+    def get_datum(self, index: int) -> DatumFields:
+        """Verified wire fields without image materialization (fused
+        native ingestion path); block-crc verification as in get()."""
+        return self._get(index, fields=True)
+
+    def _get(self, index: int, fields: bool):
         from .leveldb_io import LevelDBError
         try:
             # positional: values decode on demand from the mmap'd
@@ -292,7 +375,7 @@ class LevelDBDataset:
             raw = self._reader.value_at(index)
         except LevelDBError as e:
             raise RecordIntegrityError(self.path, index, str(e)) from e
-        return _decode_verified(raw, index, self.path)
+        return _decode_verified(raw, index, self.path, fields=fields)
 
 
 class ImageFolderDataset:
@@ -317,18 +400,15 @@ class ImageFolderDataset:
         return len(self.items)
 
     def get(self, index: int) -> tuple[np.ndarray, int]:
-        from PIL import Image
+        # decode plane (ISSUE 10): native libjpeg/libpng decode +
+        # bilinear resize when enabled (reference ReadImageToCVMat's
+        # cv::resize INTER_LINEAR), PIL fallback kept
+        from .decode import decode_file
         path, label = self.items[index]
-        img = Image.open(os.path.join(self.root, path))
-        img = img.convert("RGB" if self.is_color else "L")
-        if self.new_hw[0] and self.new_hw[1]:
-            img = img.resize((self.new_hw[1], self.new_hw[0]), Image.BILINEAR)
-        arr = np.asarray(img)
-        if arr.ndim == 2:
-            arr = arr[None, :, :]
-        else:
-            arr = arr[:, :, ::-1].transpose(2, 0, 1)  # RGB HWC -> BGR CHW
-        return arr, label
+        with open(os.path.join(self.root, path), "rb") as f:
+            data = f.read()
+        return decode_file(data, is_color=self.is_color,
+                           new_h=self.new_hw[0], new_w=self.new_hw[1]), label
 
 
 class MNISTDataset:
@@ -397,6 +477,92 @@ class CachedDataset:
         return self.records[index]
 
 
+class DecodedCacheDataset:
+    """Bounded decoded-record cache tier (ISSUE 10, solver knob
+    `decoded_cache_mb` — docs/benchmarks.md "Ingestion").
+
+    The reference DataCache (data_reader.hpp:55-101) caches every record
+    whole; `data_param { cache: true }` / CachedDataset reproduces that.
+    This tier is the bounded variant for datasets that don't fit RAM:
+    post-decode, pre-augment CHW uint8 arrays are kept up to
+    `budget_mb`, so every epoch after the first skips DB read, crc
+    verification, AND image decode for the cached span — the expensive
+    stages for JPEG/PNG-encoded DBs, which otherwise re-decode the whole
+    dataset every epoch.
+
+    Admission is first-fit and KEYED BY RECORD INDEX: once the budget is
+    reached no entry is ever evicted or replaced, so under the Feeder's
+    per-epoch permutations (epoch-shuffle semantics live upstream in
+    `_record_index`) the same records hit every epoch — deterministic,
+    and no LRU thrash when budget < dataset. Integrity is unchanged:
+    misses go through the base dataset's crc/quarantine path, and only
+    successfully decoded records are admitted (a corrupt record raises
+    before insert, on first decode, exactly as uncached).
+
+    Thread-safe: Feeder pool workers populate it concurrently. Cached
+    arrays are marked read-only — every consumer copies (f32 cast,
+    np.stack) before mutating."""
+
+    def __init__(self, base: Dataset, budget_mb: float):
+        self.base = base
+        self.path = getattr(base, "path", "") or type(base).__name__
+        self._budget = int(budget_mb * 2**20)
+        self._bytes = 0
+        self._full = False
+        self._cache: dict[int, tuple[np.ndarray, int]] = {}
+        self._lock = threading.Lock()
+        base_datum = getattr(base, "get_datum", None)
+        if base_datum is not None:
+            # expose the fused-ingestion fields API only when the base
+            # has it (the Feeder probes with getattr)
+            self.get_datum = base_datum
+
+    def __len__(self) -> int:
+        return len(self.base)
+
+    def lookup(self, index: int):
+        """Cached (arr, label) or None — the Feeder's fused path asks
+        before fetching encoded bytes."""
+        with self._lock:
+            hit = self._cache.get(index)
+        if hit is not None:
+            from .decode import STATS
+            STATS.count("cache_hits")
+        return hit
+
+    def admitting(self) -> bool:
+        """False once the budget has been hit — callers skip allocating
+        decode side-buffers that could never be admitted."""
+        return not self._full
+
+    def insert(self, index: int, arr: np.ndarray, label: int) -> None:
+        """Admit a decoded record (first-fit under the byte budget)."""
+        if arr.dtype != np.uint8 or self._full:
+            return
+        arr = np.array(arr)  # own copy: cache entries are long-lived and
+        #                      must not pin batch buffers or mmap views
+        arr.setflags(write=False)
+        with self._lock:
+            if index in self._cache:
+                return
+            if self._bytes + arr.nbytes > self._budget:
+                self._full = True
+                return
+            self._cache[index] = (arr, int(label))
+            self._bytes += arr.nbytes
+        from .decode import STATS
+        STATS.count("cache_inserts")
+        STATS.count("cache_bytes", arr.nbytes)
+
+    def get(self, index: int) -> tuple[np.ndarray, int]:
+        hit = self.lookup(index)
+        if hit is not None:
+            return hit
+        arr, label = self.base.get(index)
+        self.insert(index, arr, label)
+        return arr, label
+
+
 class SyntheticDataset:
     """Deterministic class-template images — test/bench stand-in."""
 
@@ -451,6 +617,13 @@ class DatumFileDataset:
         return _decode_verified(os.pread(self._fd, int(size), int(off)),
                                 index, self.f.name)
 
+    def get_datum(self, index: int) -> DatumFields:
+        """Verified wire fields without image materialization (fused
+        native ingestion path)."""
+        off, size = self.offsets[index]
+        return _decode_verified(os.pread(self._fd, int(size), int(off)),
+                                index, self.f.name, fields=True)
+
     @classmethod
     def write(cls, path: str, records) -> int:
         """records: iterable of encoded Datum bytes."""
@@ -483,6 +656,10 @@ class _HybridDatumDataset:
             return self.native.get(index)
         except ValueError:
             return self.py.get(index)
+
+    def get_datum(self, index: int) -> DatumFields:
+        # encoded/float records live on the python reader either way
+        return self.py.get_datum(index)
 
 
 def open_dataset(backend: str, source: str, **kw) -> Dataset:

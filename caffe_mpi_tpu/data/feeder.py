@@ -31,7 +31,7 @@ import numpy as np
 
 from ..utils.resilience import (FAULTS, QUARANTINE, DataIntegrityError,
                                 RecordIntegrityError, retrying)
-from .datasets import Dataset
+from .datasets import Dataset, DecodedCacheDataset
 from .transformer import DataTransformer
 
 log = logging.getLogger("caffe_mpi_tpu.feeder")
@@ -123,9 +123,39 @@ class Feeder:
             tp = transformer.tp
             self._native = (native.available() and not tp.force_color
                             and not tp.force_gray)
+        # fused native ingestion (ISSUE 10): for JPEG/PNG-encoded
+        # datasets, decode -> crop -> mirror -> mean/scale -> f32 (or
+        # decode-only, in device-transform staging mode) runs for the
+        # whole batch in ONE ctypes call with the GIL released
+        # (native/decode.cc), instead of one PIL decode per record under
+        # the interpreter lock. None = undecided until the first batch
+        # reveals whether the dataset carries encoded records; False =
+        # permanently on the classic path (raw/float datums — bitwise
+        # today's behavior, decided once so raw datasets never pay a
+        # re-probe).
+        self._fused_ok: bool | None = None
+        if getattr(dataset, "get_datum", None) is None:
+            self._fused_ok = False  # no wire-fields API (synthetic, image
+            #                         folder, cached) — per-record path
+        elif device_transform:
+            pass  # fused decode-only staging fill
+        elif transformer is None or not self._native:
+            self._fused_ok = False  # transform not expressible natively
+        elif (transformer.mean is not None
+              and transformer.mean.reshape(-1).size not in (1, 3)):
+            # full-image mean needs the per-record crop window at the
+            # image's own dims, which vary per encoded record; sizes 1/3
+            # broadcast over the decoder's fixed 3 BGR channels. Decided
+            # HERE so an inexpressible mean never pays the fused fetch
+            # just to bail per batch.
+            self._fused_ok = False
         self.pool = ThreadPoolExecutor(max_workers=max(threads, 1))
         self._futures: dict[int, Future] = {}
         self._lock = threading.Lock()
+        # batch builds currently executing (pool workers + direct
+        # callers) — sizes the fused decode's inner thread count so
+        # worker-count x per-call threads never oversubscribes the host
+        self._inflight = 0
         n = len(dataset)
         if n == 0:
             raise ValueError("empty dataset")
@@ -159,9 +189,27 @@ class Feeder:
                         del self._perm_cache[k]
         return int(perm[within])
 
+    def _decode_threads(self) -> int:
+        """Threads for ONE fused native decode call. An explicitly
+        pinned feeder keeps its pin (operator's choice, like the classic
+        native transform); auto mode divides the host's cores across the
+        builds in flight — 8 workers each spawning 8 decode threads is
+        the documented oversubscription collapse, not a speedup."""
+        if not self.auto:
+            return self.threads
+        with self._lock:
+            inflight = max(self._inflight, 1)
+        return max(1, (os.cpu_count() or 1) // inflight)
+
     def _build_batch(self, it: int) -> dict[str, np.ndarray]:
         t0 = time.perf_counter()
-        out = self._build_batch_inner(it)
+        with self._lock:
+            self._inflight += 1
+        try:
+            out = self._build_batch_inner(it)
+        finally:
+            with self._lock:
+                self._inflight -= 1
         if self.auto:
             # pool worker threads append concurrently with the consumer's
             # retune scan — both sides take the lock
@@ -189,26 +237,47 @@ class Feeder:
         return retrying(get, attempts=4, base_delay=0.05,
                         desc=f"dataset read (record {rec})")
 
+    def _fetch_datum(self, rec: int):
+        """One wire-fields fetch (no image materialization) with the
+        same bounded-backoff retry + fault site as _read_record — the
+        fused path's fetch stage."""
+        def get():
+            FAULTS.maybe_raise("feeder_read", OSError,
+                               f"injected dataset read fault (record {rec})")
+            return self.ds.get_datum(rec)
+        return retrying(get, attempts=4, base_delay=0.05,
+                        desc=f"dataset read (record {rec})")
+
     def _read_record_verified(self, rec: int):
-        """Read record `rec`, quarantining it on an integrity failure
-        (ISSUE 4): the substitute is the next healthy record by index —
-        `(rec + probe) % size`, probe = 1.. — a pure function of `rec`
-        (itself a pure function of the iteration index), so a resumed
-        or replayed run makes IDENTICAL substitution decisions and
-        stays iteration-exact. Each newly quarantined record is
-        journaled to `<prefix>.quarantine.json`; more than
-        `_quarantine_limit` distinct corrupt records (or a fully
-        corrupt probe window) is systematic corruption and raises
+        return self._verified(rec, self._read_record)
+
+    def _verified(self, rec: int, read_fn):
+        """Read record `rec` via `read_fn`, quarantining it on an
+        integrity failure (ISSUE 4): the substitute is the next healthy
+        record by index — `(rec + probe) % size`, probe = 1.. — a pure
+        function of `rec` (itself a pure function of the iteration
+        index), so a resumed or replayed run makes IDENTICAL
+        substitution decisions and stays iteration-exact. Each newly
+        quarantined record is journaled to `<prefix>.quarantine.json`;
+        more than `_quarantine_limit` distinct corrupt records (or a
+        fully corrupt probe window) is systematic corruption and raises
         DataIntegrityError — a hard, named failure instead of silently
-        training on substitutes."""
+        training on substitutes.
+
+        `read_fn` is _read_record (full materialization) or
+        _fetch_datum (wire fields only, the fused path — decode
+        failures there re-enter through _read_record_verified, which
+        may add the rotten substitute to the journal one step later
+        than the classic path would have; the substitution function
+        itself is identical)."""
         sub = self._sub_cache.get(rec)
         if sub is not None:
             # recurse: if the memoized substitute has ITSELF rotted
             # since, it gets quarantined like any primary record
             # (depth bounded by the quarantine limit)
-            return self._read_record_verified(sub)
+            return self._verified(sub, read_fn)
         try:
-            return self._read_record(rec)
+            return read_fn(rec)
         except RecordIntegrityError as first:
             src = getattr(self.ds, "path", "") or type(self.ds).__name__
             with self._lock:
@@ -224,7 +293,7 @@ class Feeder:
             for probe in range(1, _QUARANTINE_PROBES + 1):
                 sub = (rec + probe) % self._size
                 try:
-                    out = self._read_record(sub)
+                    out = read_fn(sub)
                 except RecordIntegrityError as e:
                     with self._lock:
                         self._quarantined.add(sub)
@@ -243,7 +312,24 @@ class Feeder:
                 "consecutive); corruption is systematic — regenerate "
                 f"the dataset (first failure: {first})") from first
 
+    def _assemble(self, raws: list[np.ndarray], labels: list[int],
+                  flats: list[int]) -> dict[str, np.ndarray]:
+        """Shared batch tail: transform/stage + label top."""
+        if self.device_transform:
+            out = self._raw_batch(raws, flats)
+        else:
+            out = {self.top_names[0]: self._transform(raws, flats)}
+        if len(self.top_names) > 1:
+            out[self.top_names[1]] = np.asarray(labels, np.int32)
+        return out
+
     def _build_batch_inner(self, it: int) -> dict[str, np.ndarray]:
+        if self._fused_ok is not False:
+            from . import decode as _decode
+            if _decode.native_enabled():
+                out = self._build_batch_fused(it)
+                if out is not None:
+                    return out
         raws, labels, flats = [], [], []
         for slot in range(self.batch):
             rec = self._record_index(it, slot)
@@ -252,13 +338,234 @@ class Feeder:
             labels.append(label)
             flats.append(it * self.batch * self.world
                          + self.rank * self.batch + slot)
+        return self._assemble(raws, labels, flats)
+
+    # -- fused native ingestion (ISSUE 10) ------------------------------
+    def _build_batch_fused(self, it: int) -> dict[str, np.ndarray] | None:
+        """Batch build for encoded datasets: fetch verified wire fields
+        per record, then decode JPEG/PNG payloads for the WHOLE batch in
+        one GIL-released native call — fused with the transform
+        (host-transform mode) or decoding straight into the uniform
+        uint8 staging stack (device-transform mode). Cache hits
+        (DecodedCacheDataset) skip decode entirely; records the native
+        decoder declines fall back one-at-a-time through the classic
+        read path, which owns PIL fallback and quarantine. Augmentation
+        keys (seed ^ flat-index splitmix64) and the transform arithmetic
+        are shared with the classic native path (transform_core.h), so
+        engagement changes WHICH decoder ran, never the aug decisions or
+        the record->rank striping.
+
+        Returns None exactly once, when the first batch shows the
+        dataset has no encoded records — then the Feeder pins itself to
+        the classic path (`_fused_ok = False`) and never re-probes."""
+        from . import decode as _decode
+        from .. import native
+
+        cache = self.ds if isinstance(self.ds, DecodedCacheDataset) else None
+        recs, flats = [], []
+        for slot in range(self.batch):
+            recs.append(self._record_index(it, slot))
+            flats.append(it * self.batch * self.world
+                         + self.rank * self.batch + slot)
+        # per slot: ("enc", jpeg/png bytes, label) | ("arr", CHW, label)
+        entries: list[tuple] = []
+        for rec in recs:
+            hit = cache.lookup(rec) if cache is not None else None
+            if hit is not None:
+                entries.append(("arr", hit[0], hit[1]))
+                continue
+            fields = self._verified(rec, self._fetch_datum)
+            if fields.encoded:
+                entries.append(("enc", fields.data, fields.label))
+            else:
+                # raw/float datum: materialize in place (identical to
+                # what ds.get(rec) would have returned)
+                from .datasets import materialize_datum
+                try:
+                    arr, label = materialize_datum(fields)
+                except Exception:
+                    arr, label = self._read_record_verified(rec)
+                entries.append(("arr", arr, label))
+        if self._fused_ok is None:
+            self._fused_ok = any(e[0] == "enc" for e in entries)
+            if not self._fused_ok:
+                # not an encoded dataset: assemble this batch from the
+                # already-fetched records (bitwise-identical tail) and
+                # stay classic forever
+                return self._assemble([e[1] for e in entries],
+                                      [e[2] for e in entries], flats)
+        enc = [i for i, e in enumerate(entries) if e[0] == "enc"]
         if self.device_transform:
-            out = self._raw_batch(raws, flats)
+            out = self._fused_staging(entries, enc, recs, flats, cache)
         else:
-            out = {self.top_names[0]: self._transform(raws, flats)}
-        if len(self.top_names) > 1:
-            out[self.top_names[1]] = np.asarray(labels, np.int32)
+            out = self._fused_transform(entries, enc, recs, flats, cache)
+        if out is not None and enc:
+            # fused_records is counted per SUCCESSFUL record inside the
+            # helpers (statuses in hand) — a declined record must show
+            # up as a PIL fallback, not a native decode, or the
+            # --require-native-decode assertion would pass on a run
+            # that silently fell back wholesale
+            _decode.STATS.count("fused_batches")
         return out
+
+    def _fallback_record(self, slot_rec: int):
+        """Per-record fallback for payloads the native decoder declined
+        (exotic variant or corrupt bytes): the classic verified read
+        decodes via PIL and owns quarantine."""
+        from . import decode as _decode
+        _decode.STATS.count("fused_fallback_records")
+        return self._read_record_verified(slot_rec)
+
+    def _fused_transform(self, entries, enc, recs, flats, cache):
+        """Host-transform mode: one native call decodes + transforms all
+        encoded slots into their f32 rows (per-record decoded dims may
+        vary when cropping — the C side crops each at its own size)."""
+        from .. import native
+        if not enc:
+            # nothing to decode (all cache hits / raw slots): the classic
+            # tail IS the fast path — one native transform_batch over the
+            # stacked uint8 records, no staging array or scatter
+            return self._assemble([e[1] for e in entries],
+                                  [e[2] for e in entries], flats)
+        tf = self.tf
+        crop = tf.tp.crop_size
+        n = len(entries)
+        labels = [e[2] for e in entries]
+        if crop:
+            oh = ow = crop
+        else:
+            # no crop: output dims = decoded dims, which must be uniform
+            first = entries[0]
+            if first[0] == "arr":
+                oh, ow = first[1].shape[-2:]
+            else:
+                dims = native.decode_probe(first[1])
+                if dims is None:
+                    arr, labels[0] = self._fallback_record(recs[0])
+                    entries[0] = ("arr", arr, labels[0])
+                    oh, ow = arr.shape[-2:]
+                else:
+                    oh, ow = dims
+            enc = [i for i in enc if entries[i][0] == "enc"]
+        mean = tf.mean
+        if mean is not None:
+            mean = mean.reshape(-1)  # per-channel (c,1,1)/(c,) -> (c,)
+            if mean.size == 1:
+                # single mean_value applies to every channel (reference
+                # data_transformer.cpp: mean_values_ repeated)
+                mean = np.repeat(mean, 3)
+        out = np.empty((n, 3, oh, ow), np.float32)
+        seed = tf.seed or 0
+        train = tf.phase == "TRAIN"
+        if enc:
+            bufs = [entries[i][1] for i in enc]
+            ids = np.asarray([flats[i] for i in enc], np.int64)
+            # whole-batch encoded (the common case): the C call writes
+            # each record's f32 row straight into `out` — no staging
+            # array, no scatter copy. Mixed batches (cache hits / raw
+            # slots interleaved) stage the encoded subset and scatter.
+            whole = len(enc) == n
+            enc_out = out if whole else np.empty((len(enc), 3, oh, ow),
+                                                 np.float32)
+            decoded = None
+            if cache is not None and cache.admitting():
+                decoded = []
+                for b in bufs:
+                    dims = native.decode_probe(b)
+                    decoded.append(None if dims is None else
+                                   np.empty((3, *dims), np.uint8))
+            status = native.decode_transform_batch(
+                bufs, ids, crop=crop, mean=mean, scale=tf.tp.scale,
+                train=train, mirror=tf.tp.mirror, seed=seed,
+                out_h=oh, out_w=ow, out=enc_out, decoded_out=decoded,
+                num_threads=self._decode_threads())
+            from . import decode as _decode
+            for k, i in enumerate(enc):
+                if status[k] == native.DECODE_OK:
+                    _decode.STATS.count("fused_records")
+                    if not whole:
+                        out[i] = enc_out[k]
+                    if decoded is not None and decoded[k] is not None:
+                        cache.insert(recs[i], decoded[k], labels[i])
+                else:
+                    # failed rows left garbage in `out`; the fallback
+                    # re-read below rewrites them via the "arr" pass
+                    arr, labels[i] = self._fallback_record(recs[i])
+                    entries[i] = ("arr", arr, labels[i])
+        # cache hits, raw records, and fallbacks: the classic transform
+        # (native batch call per uniform-shape group, python otherwise)
+        rest = [i for i in range(len(entries)) if entries[i][0] == "arr"]
+        if rest:
+            shapes = {entries[i][1].shape for i in rest}
+            dtypes = {entries[i][1].dtype for i in rest}
+            if len(shapes) == 1 and dtypes == {np.dtype(np.uint8)}:
+                rows = self._transform([entries[i][1] for i in rest],
+                                       [flats[i] for i in rest])
+                for k, i in enumerate(rest):
+                    out[i] = rows[k]
+            else:
+                for i in rest:
+                    out[i] = self._transform([entries[i][1]], [flats[i]])[0]
+        res = {self.top_names[0]: out}
+        if len(self.top_names) > 1:
+            res[self.top_names[1]] = np.asarray(labels, np.int32)
+        return res
+
+    def _fused_staging(self, entries, enc, recs, flats, cache):
+        """Device-transform mode: decode encoded slots straight into the
+        uniform uint8 staging stack (the in-graph transform consumes raw
+        records + aug decisions; reference use_gpu_transform)."""
+        from .. import native
+        from .device_transform import aug_key, compute_aug
+        n = len(entries)
+        labels = [e[2] for e in entries]
+        first = entries[0]
+        if first[0] == "arr":
+            shape = first[1].shape
+        else:
+            dims = native.decode_probe(first[1])
+            if dims is None:
+                arr, labels[0] = self._fallback_record(recs[0])
+                entries[0] = ("arr", arr, labels[0])
+                shape = arr.shape
+            else:
+                shape = (3, *dims)
+            enc = [i for i in enc if entries[i][0] == "enc"]
+        if len(shape) != 3 or shape[0] != 3:
+            return None  # encoded records decode to 3xHxW; mismatch ->
+            #              classic path handles (and errors) as before
+        stack = np.empty((n, *shape), np.uint8)
+        if enc:
+            bufs = [entries[i][1] for i in enc]
+            ids = np.asarray([flats[i] for i in enc], np.int64)
+            status = native.decode_transform_batch(
+                bufs, ids, out_h=shape[1], out_w=shape[2], out=None,
+                decoded_out=[stack[i] for i in enc],
+                num_threads=self._decode_threads())
+            from . import decode as _decode
+            for k, i in enumerate(enc):
+                if status[k] != native.DECODE_OK:
+                    arr, labels[i] = self._fallback_record(recs[i])
+                    entries[i] = ("arr", arr, labels[i])
+                else:
+                    _decode.STATS.count("fused_records")
+                    if cache is not None and cache.admitting():
+                        cache.insert(recs[i], stack[i].copy(), labels[i])
+        for i in range(n):
+            kind, payload = entries[i][0], entries[i][1]
+            if kind == "arr":
+                if payload.shape != shape or payload.dtype != np.uint8:
+                    raise ValueError(
+                        "device transform requires uniform uint8 records; "
+                        "set transform_param { use_gpu_transform: false } "
+                        "for this dataset")
+                stack[i] = payload
+        aug = compute_aug(self.tf, flats, shape[-2:], n)
+        res = {self.top_names[0]: stack,
+               aug_key(self.top_names[0]): aug}
+        if len(self.top_names) > 1:
+            res[self.top_names[1]] = np.asarray(labels, np.int32)
+        return res
 
     def _raw_batch(self, raws: list[np.ndarray], flats: list[int]) -> dict:
         """Device-transform staging: uint8 stack + (B,3) aug decisions
@@ -288,6 +595,12 @@ class Feeder:
             mean = tf.mean
             if mean is not None and mean.ndim == 3 and mean.shape[1] == 1:
                 mean = mean.reshape(-1)  # per-channel (c,1,1) -> (c,)
+                if mean.size == 1 and raws[0].shape[0] > 1:
+                    # single mean_value broadcasts over channels
+                    # (reference data_transformer.cpp); the C kernel
+                    # indexes mean[ch], so repeat instead of letting it
+                    # read past a 1-float buffer
+                    mean = np.repeat(mean, raws[0].shape[0])
             return native.transform_batch(
                 np.stack(raws), np.asarray(flats, np.int64),
                 crop=tf.tp.crop_size, mean=mean, scale=tf.tp.scale,
@@ -481,11 +794,16 @@ class DeviceFeedQueue:
 
 def feeder_from_layer(lp, phase: str, *, rank: int = 0, world: int = 1,
                       model_dir: str = "",
-                      device_transform: bool = False) -> Feeder:
+                      device_transform: bool = False,
+                      solver_param=None) -> Feeder:
     """Build a Feeder from a Data/ImageData layer's prototxt config — the
     runner-side binding for DB-backed layers (reference
     DataLayer::LayerSetUp, data_layer.cpp:118-180). device_transform must
-    be the consuming net's DataLayer.dev_transform."""
+    be the consuming net's DataLayer.dev_transform. solver_param (when
+    given) supplies run-level ingestion knobs: `decoded_cache_mb` > 0
+    wraps the dataset in the bounded decoded-record cache tier
+    (ISSUE 10, datasets.DecodedCacheDataset) unless the layer already
+    opted into the whole-DB cache."""
     import os
 
     from .datasets import ImageFolderDataset, open_dataset
@@ -493,12 +811,20 @@ def feeder_from_layer(lp, phase: str, *, rank: int = 0, world: int = 1,
     tp = lp.transform_param
     tf = DataTransformer(tp, phase, model_dir=model_dir)
     tops = tuple(lp.top)
+    cache_mb = float(getattr(solver_param, "decoded_cache_mb", 0.0) or 0.0)
+    if cache_mb < 0:
+        # loud, like every sibling knob (reduce_buckets/serve_* reject
+        # negatives at init) — a typo'd budget must not silently
+        # disable the cache
+        raise ValueError(f"decoded_cache_mb must be >= 0, got {cache_mb}")
     if lp.type == "Data":
         p = lp.data_param
         ds = open_dataset(str(p.backend), os.path.join(model_dir, p.source))
         if p.cache:  # whole-DB RAM cache (reference data_param.cache)
             from .datasets import CachedDataset
             ds = CachedDataset(ds)
+        elif cache_mb > 0:
+            ds = DecodedCacheDataset(ds, cache_mb)
         shuffle = bool(p.shuffle) and phase == "TRAIN"
         # threads=0 (prototxt default) -> auto mode; prefetch seeds the
         # initial lookahead window (reference data_param.prefetch)

@@ -58,6 +58,44 @@ def _load():
                                                     ctypes.c_int64]
     except AttributeError:
         pass
+    # decode plane (ISSUE 10); a pre-existing .so without the symbols
+    # still loads (PIL decode is the fallback)
+    try:
+        lib.caffe_tpu_decode_available.restype = ctypes.c_int
+        lib.caffe_tpu_decode_available.argtypes = []
+        lib.caffe_tpu_decode_probe.restype = ctypes.c_int
+        lib.caffe_tpu_decode_probe.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+        lib.caffe_tpu_decode_image.restype = ctypes.c_int
+        lib.caffe_tpu_decode_image.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+        lib.caffe_tpu_decode_resize.restype = ctypes.c_int
+        lib.caffe_tpu_decode_resize.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
+        lib.caffe_tpu_decode_transform_batch.restype = ctypes.c_int
+        lib.caffe_tpu_decode_transform_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),          # srcs
+            ctypes.POINTER(ctypes.c_int64),           # lens
+            ctypes.POINTER(ctypes.c_int64),           # record_ids
+            ctypes.c_int,                             # n
+            ctypes.c_int,                             # crop
+            ctypes.c_void_p,                          # mean
+            ctypes.c_int, ctypes.c_float,             # mean_mode, scale
+            ctypes.c_int, ctypes.c_int,               # train, mirror
+            ctypes.c_uint64,                          # seed
+            ctypes.c_int, ctypes.c_int,               # out_h, out_w
+            ctypes.POINTER(ctypes.c_float),           # out (nullable)
+            ctypes.POINTER(ctypes.c_void_p),          # decoded_out (nullable)
+            ctypes.POINTER(ctypes.c_int64),           # decoded_caps
+            ctypes.POINTER(ctypes.c_int32),           # status
+            ctypes.c_int,                             # num_threads
+        ]
+    except AttributeError:
+        pass
     lib.caffe_tpu_transform_batch.restype = ctypes.c_int
     lib.caffe_tpu_transform_batch.argtypes = [
         ctypes.POINTER(ctypes.c_void_p),          # srcs
@@ -233,3 +271,141 @@ def transform_batch(images: np.ndarray, record_ids: np.ndarray, *,
     if rc != 0:
         raise RuntimeError(f"native transform failed with code {rc}")
     return out
+
+
+# ---------------------------------------------------------------------------
+# Decode plane (ISSUE 10, decode.cc). Status codes match the C enum;
+# "not handled natively" statuses (unknown format / unsupported variant /
+# codec-less build) map to None returns so callers fall back to PIL —
+# geometry/buffer statuses are caller bugs and raise.
+# ---------------------------------------------------------------------------
+
+DECODE_OK = 0
+DECODE_UNKNOWN_FORMAT = 1
+DECODE_ERROR = 2
+DECODE_GEOMETRY = 3
+DECODE_BUFFER = 4
+DECODE_UNAVAILABLE = 5
+# statuses that mean "this record is not ours — hand it to PIL"
+_DECODE_FALLBACK = (DECODE_UNKNOWN_FORMAT, DECODE_ERROR, DECODE_UNAVAILABLE)
+
+
+def decode_available() -> bool:
+    """True when the loaded .so was built with libjpeg/libpng (the
+    decode entry points exist AND were not compiled as stubs)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "caffe_tpu_decode_available"):
+        return False
+    return bool(lib.caffe_tpu_decode_available())
+
+
+def decode_probe(data: bytes) -> tuple[int, int] | None:
+    """Header-only (h, w) of JPEG/PNG bytes; None = not natively
+    decodable (decoded output is always 3-channel BGR)."""
+    lib = _load()
+    h, w = ctypes.c_int(), ctypes.c_int()
+    rc = lib.caffe_tpu_decode_probe(data, len(data), ctypes.byref(h),
+                                    ctypes.byref(w))
+    if rc in _DECODE_FALLBACK:
+        return None
+    if rc != DECODE_OK:
+        raise RuntimeError(f"native decode probe failed with code {rc}")
+    return h.value, w.value
+
+
+def decode_image_native(data: bytes) -> np.ndarray | None:
+    """JPEG/PNG bytes -> (3, h, w) planar BGR uint8, or None when the
+    record is not natively decodable (caller falls back to PIL)."""
+    lib = _load()
+    dims = decode_probe(data)
+    if dims is None:
+        return None
+    h, w = dims
+    out = np.empty((3, h, w), np.uint8)
+    oh, ow = ctypes.c_int(), ctypes.c_int()
+    rc = lib.caffe_tpu_decode_image(
+        data, len(data), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.nbytes, ctypes.byref(oh), ctypes.byref(ow))
+    if rc in _DECODE_FALLBACK:
+        return None
+    if rc != DECODE_OK:
+        raise RuntimeError(f"native decode failed with code {rc}")
+    return out
+
+
+def decode_resize_native(data: bytes, out_h: int,
+                         out_w: int) -> np.ndarray | None:
+    """JPEG/PNG bytes -> decode + bilinear resize (cv::resize
+    INTER_LINEAR convention, the reference ImageData layer's semantics)
+    -> (3, out_h, out_w) planar BGR uint8; None = PIL fallback."""
+    lib = _load()
+    out = np.empty((3, out_h, out_w), np.uint8)
+    rc = lib.caffe_tpu_decode_resize(
+        data, len(data), out_h, out_w,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), out.nbytes)
+    if rc in _DECODE_FALLBACK:
+        return None
+    if rc != DECODE_OK:
+        raise RuntimeError(f"native decode+resize failed with code {rc}")
+    return out
+
+
+def decode_transform_batch(bufs: list[bytes], record_ids, *,
+                           crop: int = 0, mean: np.ndarray | None = None,
+                           scale: float = 1.0, train: bool = True,
+                           mirror: bool = False, seed: int = 0,
+                           out_h: int, out_w: int,
+                           out: np.ndarray | None = None,
+                           decoded_out: list[np.ndarray | None] | None = None,
+                           num_threads: int = 4):
+    """Fused ingestion: decode -> crop -> mirror -> mean/scale -> f32 for
+    a range of records in ONE ctypes call (GIL released for the whole
+    batch). Augmentation keys and arithmetic are identical to
+    transform_batch (shared transform_core.h).
+
+    out: (n, 3, out_h, out_w) float32 to fill, or None for decode-only
+    mode (the device-transform staging fill — then out_h/out_w are the
+    REQUIRED decoded dims). decoded_out: optional per-record (3, h, w)
+    uint8 buffers (each entry may be None) receiving the raw decode —
+    the decoded-record cache fill. Returns the (n,) int32 per-record
+    status array; rows whose status != DECODE_OK are untouched and the
+    caller re-reads those records through the PIL + quarantine path.
+    Full-image mean is not expressible here (decoded dims vary per
+    record); callers keep such transforms on the per-record path."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library not built; run native/build.sh")
+    n = len(bufs)
+    srcs = (ctypes.c_char_p * n)(*bufs)
+    lens = np.asarray([len(b) for b in bufs], np.int64)
+    rec = np.ascontiguousarray(record_ids, np.int64)
+    mean_mode = 0
+    mean_ptr = None
+    if mean is not None:
+        mean = np.ascontiguousarray(mean, np.float32).reshape(-1)
+        mean_mode = 1
+        mean_ptr = mean.ctypes.data_as(ctypes.c_void_p)
+    out_ptr = None
+    if out is not None:
+        assert out.dtype == np.float32 and out.flags.c_contiguous
+        out_ptr = out.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+    dec_ptrs = None
+    caps = np.zeros(n, np.int64)
+    if decoded_out is not None:
+        dec_ptrs = (ctypes.c_void_p * n)()
+        for i, buf in enumerate(decoded_out):
+            if buf is not None:
+                assert buf.dtype == np.uint8 and buf.flags.c_contiguous
+                dec_ptrs[i] = buf.ctypes.data
+                caps[i] = buf.nbytes
+    status = np.empty(n, np.int32)
+    rc = lib.caffe_tpu_decode_transform_batch(
+        srcs, lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        rec.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+        crop, mean_ptr, mean_mode, scale, int(train), int(mirror), seed,
+        out_h, out_w, out_ptr, dec_ptrs,
+        caps.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        status.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), num_threads)
+    if rc != 0:
+        raise RuntimeError(f"native fused decode call rejected (code {rc})")
+    return status

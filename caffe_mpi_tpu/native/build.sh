@@ -1,12 +1,36 @@
 #!/bin/sh
 # Build the native library in place. CMake+ninja when available, plain g++
 # otherwise. Output: libcaffe_tpu_native.so next to this script.
+#
+# The decode plane (decode.cc, ISSUE 10) needs libjpeg + libpng dev
+# headers; when either is missing the library still builds with the
+# decode entry points stubbed to "unavailable" (-DCAFFE_TPU_NO_CODEC) and
+# the Python side stays on its PIL fallback — transform/reader
+# functionality never degrades with the codecs.
 set -e
 cd "$(dirname "$0")"
+
+# codec probe: compile a header-only check rather than guessing paths —
+# whatever include dirs the compiler really resolves are what decode.cc
+# will see
+CODEC_FLAGS="-DCAFFE_TPU_NO_CODEC"
+CODEC_LIBS=""
+if printf '#include <cstddef>\n#include <cstdio>\n#include <jpeglib.h>\n#include <png.h>\nint main(){return 0;}\n' \
+     | g++ -x c++ - -o /dev/null -ljpeg -lpng 2>/dev/null; then
+  CODEC_FLAGS=""
+  CODEC_LIBS="-ljpeg -lpng"
+else
+  echo "warning: libjpeg/libpng dev headers not found;" \
+       "building transform-only (PIL decode fallback stays active)" >&2
+fi
+
 if command -v cmake >/dev/null 2>&1 && command -v ninja >/dev/null 2>&1; then
   cmake -G Ninja -B build -DCMAKE_BUILD_TYPE=Release >/dev/null
   ninja -C build >/dev/null
 else
-  g++ -O3 -fPIC -shared -std=c++17 -pthread transform.cc datumdb.cc lmdb_reader.cc -o libcaffe_tpu_native.so
+  # shellcheck disable=SC2086 — CODEC_* are intentionally word-split flags
+  g++ -O3 -fPIC -shared -std=c++17 -pthread $CODEC_FLAGS \
+      transform.cc datumdb.cc lmdb_reader.cc decode.cc \
+      -o libcaffe_tpu_native.so $CODEC_LIBS
 fi
 echo "built $(pwd)/libcaffe_tpu_native.so"

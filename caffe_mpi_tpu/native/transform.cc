@@ -9,31 +9,18 @@
 // multithreaded C++, called from the Python Feeder via ctypes (GIL released
 // during the call).
 //
-// Crop/mirror randomness is counter-based (splitmix64 keyed on
-// seed ^ record_index) so augmentation is deterministic per record
-// regardless of thread scheduling — the same property the Python path gets
-// from Philox streams (values differ between the two paths; determinism
-// within a path is the contract, as in the reference's per-thread RNGs).
-//
-// Semantics mirror data_transformer.cpp Transform(): TEST phase -> center
-// crop, no mirror; TRAIN -> uniform random crop offset + 50% mirror;
-// out = (pixel - mean) * scale; mean is per-channel or full-image (subtracted
-// at the same crop window).
+// The per-image crop/mirror/mean/scale arithmetic (and its counter-based
+// splitmix64 augmentation keying) lives in transform_core.h, shared with
+// decode.cc's fused decode->transform entry point (ISSUE 10) so the two
+// paths stay bitwise-identical for the same decoded pixels.
 
-#include <atomic>
 #include <cstdint>
-#include <cstring>
 #include <thread>
 #include <vector>
 
-namespace {
+#include "transform_core.h"
 
-inline uint64_t splitmix64(uint64_t x) {
-  x += 0x9e3779b97f4a7c15ull;
-  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
-  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
-  return x ^ (x >> 31);
-}
+namespace {
 
 struct TransformArgs {
   const uint8_t* const* srcs;  // n pointers to CHW uint8 images
@@ -52,54 +39,12 @@ struct TransformArgs {
 void transform_range(const TransformArgs& a, int begin, int end) {
   const int oh = a.crop ? a.crop : a.h;
   const int ow = a.crop ? a.crop : a.w;
-  const int64_t in_plane = (int64_t)a.h * a.w;
   const int64_t out_plane = (int64_t)oh * ow;
   for (int i = begin; i < end; ++i) {
-    const uint8_t* src = a.srcs[i];
-    float* dst = a.out + (int64_t)i * a.c * out_plane;
-    int off_h = 0, off_w = 0, do_mirror = 0;
-    if (a.crop) {
-      if (a.train) {
-        uint64_t r = splitmix64(a.seed ^ (uint64_t)a.record_ids[i]);
-        off_h = (int)(r % (uint64_t)(a.h - a.crop + 1));
-        r = splitmix64(r);
-        off_w = (int)(r % (uint64_t)(a.w - a.crop + 1));
-        if (a.mirror) {
-          r = splitmix64(r);
-          do_mirror = (int)(r & 1);
-        }
-      } else {
-        off_h = (a.h - a.crop) / 2;
-        off_w = (a.w - a.crop) / 2;
-      }
-    } else if (a.train && a.mirror) {
-      uint64_t r = splitmix64(a.seed ^ (uint64_t)a.record_ids[i]);
-      do_mirror = (int)(r & 1);
-    }
-    for (int ch = 0; ch < a.c; ++ch) {
-      const uint8_t* splane = src + ch * in_plane;
-      const float* mplane =
-          a.mean_mode == 2 ? a.mean + ch * in_plane : nullptr;
-      const float mch = a.mean_mode == 1 ? a.mean[ch] : 0.f;
-      float* dplane = dst + ch * out_plane;
-      for (int y = 0; y < oh; ++y) {
-        const uint8_t* srow = splane + (int64_t)(y + off_h) * a.w + off_w;
-        const float* mrow =
-            mplane ? mplane + (int64_t)(y + off_h) * a.w + off_w : nullptr;
-        float* drow = dplane + (int64_t)y * ow;
-        if (do_mirror) {
-          for (int x = 0; x < ow; ++x) {
-            const float m = mrow ? mrow[x] : mch;
-            drow[ow - 1 - x] = ((float)srow[x] - m) * a.scale;
-          }
-        } else {
-          for (int x = 0; x < ow; ++x) {
-            const float m = mrow ? mrow[x] : mch;
-            drow[x] = ((float)srow[x] - m) * a.scale;
-          }
-        }
-      }
-    }
+    caffe_tpu::transform_one(a.srcs[i], a.c, a.h, a.w, a.crop, a.mean,
+                             a.mean_mode, a.scale, a.train, a.mirror, a.seed,
+                             a.record_ids[i],
+                             a.out + (int64_t)i * a.c * out_plane);
   }
 }
 

@@ -1,0 +1,92 @@
+// Shared per-image transform core — the one arithmetic both entry points
+// run (reference data_transformer.cpp Transform()).
+//
+// transform.cc (uint8 batch -> f32 batch) and decode.cc (encoded bytes ->
+// decode -> f32 batch, ISSUE 10's fused ingestion path) must produce
+// BITWISE-identical output for the same decoded pixels and the same
+// (seed, record_id) augmentation keys. Keeping the crop/mirror/mean/scale
+// inner loop in ONE inline function is what holds that contract — a copy
+// in each .cc would drift.
+//
+// Augmentation randomness is counter-based splitmix64 keyed on
+// seed ^ record_id, deterministic per record regardless of thread
+// scheduling (the native analogue of the Python path's per-record Philox
+// streams; values differ between paths, determinism within a path is the
+// contract, as with the reference's per-thread RNGs).
+
+#ifndef CAFFE_TPU_NATIVE_TRANSFORM_CORE_H_
+#define CAFFE_TPU_NATIVE_TRANSFORM_CORE_H_
+
+#include <cstdint>
+
+namespace caffe_tpu {
+
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ull;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+  return x ^ (x >> 31);
+}
+
+// Transform ONE planar-CHW uint8 image into planar-CHW float32.
+// Semantics mirror data_transformer.cpp Transform(): TEST phase (train=0)
+// -> center crop, no mirror; TRAIN -> uniform random crop offset + 50%
+// mirror; out = (pixel - mean) * scale; mean_mode 0 none, 1 per-channel
+// (c floats), 2 full image (c*h*w floats, subtracted at the same crop
+// window). dst must hold c * oh * ow floats where oh = ow = crop when
+// crop > 0, else oh = h, ow = w.
+inline void transform_one(const uint8_t* src, int c, int h, int w, int crop,
+                          const float* mean, int mean_mode, float scale,
+                          int train, int mirror, uint64_t seed,
+                          int64_t record_id, float* dst) {
+  const int oh = crop ? crop : h;
+  const int ow = crop ? crop : w;
+  const int64_t in_plane = (int64_t)h * w;
+  const int64_t out_plane = (int64_t)oh * ow;
+  int off_h = 0, off_w = 0, do_mirror = 0;
+  if (crop) {
+    if (train) {
+      uint64_t r = splitmix64(seed ^ (uint64_t)record_id);
+      off_h = (int)(r % (uint64_t)(h - crop + 1));
+      r = splitmix64(r);
+      off_w = (int)(r % (uint64_t)(w - crop + 1));
+      if (mirror) {
+        r = splitmix64(r);
+        do_mirror = (int)(r & 1);
+      }
+    } else {
+      off_h = (h - crop) / 2;
+      off_w = (w - crop) / 2;
+    }
+  } else if (train && mirror) {
+    uint64_t r = splitmix64(seed ^ (uint64_t)record_id);
+    do_mirror = (int)(r & 1);
+  }
+  for (int ch = 0; ch < c; ++ch) {
+    const uint8_t* splane = src + ch * in_plane;
+    const float* mplane = mean_mode == 2 ? mean + ch * in_plane : nullptr;
+    const float mch = mean_mode == 1 ? mean[ch] : 0.f;
+    float* dplane = dst + ch * out_plane;
+    for (int y = 0; y < oh; ++y) {
+      const uint8_t* srow = splane + (int64_t)(y + off_h) * w + off_w;
+      const float* mrow =
+          mplane ? mplane + (int64_t)(y + off_h) * w + off_w : nullptr;
+      float* drow = dplane + (int64_t)y * ow;
+      if (do_mirror) {
+        for (int x = 0; x < ow; ++x) {
+          const float m = mrow ? mrow[x] : mch;
+          drow[ow - 1 - x] = ((float)srow[x] - m) * scale;
+        }
+      } else {
+        for (int x = 0; x < ow; ++x) {
+          const float m = mrow ? mrow[x] : mch;
+          drow[x] = ((float)srow[x] - m) * scale;
+        }
+      }
+    }
+  }
+}
+
+}  // namespace caffe_tpu
+
+#endif  // CAFFE_TPU_NATIVE_TRANSFORM_CORE_H_

@@ -1053,6 +1053,19 @@ class SolverParameter(Message):
     # consecutive clean (non-overflow) steps before the dynamic loss
     # scale grows 2x (capped); ignored for static scales.
     loss_scale_window: int = 200
+    # TPU-native extension (ISSUE 10, native ingestion fast path —
+    # docs/benchmarks.md "Ingestion"): budget in MiB for the bounded
+    # decoded-record cache tier (data/datasets.py DecodedCacheDataset).
+    # > 0 wraps every DB-backed data layer's dataset so post-decode,
+    # pre-augment uint8 records are kept in RAM up to the budget —
+    # epochs after the first skip DB read + crc verify + JPEG/PNG
+    # decode for the cached span (admission is first-fit by record
+    # index: deterministic, no LRU thrash under epoch shuffle).
+    # 0 (default) = off; `data_param { cache: true }` (the reference's
+    # whole-DB DataCache) takes precedence where set. The companion
+    # env CAFFE_NATIVE_DECODE=0/1 forces the PIL/native decoder for
+    # A/B runs (unset = native when built).
+    decoded_cache_mb: float = 0.0
     # TPU-native extension (ISSUE 3): dispatch watchdog deadline in
     # seconds. >0 arms a monitor thread that journals the run state and
     # hard-exits (exit code 86) when any device dispatch/harvest blocks

@@ -243,8 +243,12 @@ class SGDSolver:
             model_dir = os.path.dirname(os.path.abspath(solver_file))
         self._solver = _Solver(self._sp, model_dir=model_dir)
         from .tools.cli import _build_feeders
+        # solver_param carries run-level ingestion knobs (ISSUE 10
+        # decoded_cache_mb) so a prototxt that sets them behaves the
+        # same here as under `caffe train`
         self._feeder = _build_feeders(self._solver.net, "TRAIN",
-                                      model_dir=model_dir)
+                                      model_dir=model_dir,
+                                      solver_param=self._sp)
 
     @property
     def net(self):

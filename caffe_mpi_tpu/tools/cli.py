@@ -130,6 +130,17 @@ def _parser() -> argparse.ArgumentParser:
                    help="clean steps before the dynamic loss scale "
                    "grows 2x (overrides solver loss_scale_window; 0 = "
                    "prototxt value, which defaults to 200)")
+    # ingestion flags (ISSUE 10, docs/benchmarks.md "Ingestion")
+    p.add_argument("-decoded_cache_mb", "--decoded-cache-mb",
+                   dest="decoded_cache_mb", type=float, default=0.0,
+                   help="train: RAM budget (MiB) for the bounded "
+                   "decoded-record cache — post-decode pre-augment "
+                   "uint8 records kept across epochs so the cached "
+                   "span skips DB read + crc + JPEG/PNG decode after "
+                   "epoch 1 (overrides solver decoded_cache_mb; 0 = "
+                   "prototxt value, default off). The companion env "
+                   "CAFFE_NATIVE_DECODE=0/1 forces the PIL/native "
+                   "decoder for A/B runs")
     # survivable-training flags (ISSUE 3, utils/resilience.py)
     p.add_argument("-resume", "--resume", default="",
                    help="'auto' = resume from the newest VERIFIED "
@@ -300,8 +311,10 @@ def _synthetic_feed(net, seed=0):
     return feeds
 
 
-def _build_feeders(net, phase, rank=0, world=1, model_dir=""):
-    """Create a Feeder per DB-backed data layer, or None for Input nets."""
+def _build_feeders(net, phase, rank=0, world=1, model_dir="",
+                   solver_param=None):
+    """Create a Feeder per DB-backed data layer, or None for Input nets.
+    solver_param supplies run-level ingestion knobs (decoded_cache_mb)."""
     from ..data import feeder_from_layer
     from ..data.feeder import HDF5Feeder
     model_dir = model_dir or getattr(net, "model_dir", "")
@@ -309,7 +322,8 @@ def _build_feeders(net, phase, rank=0, world=1, model_dir=""):
         if layer.lp.type in ("Data", "ImageData"):
             return feeder_from_layer(
                 layer.lp, phase, rank=rank, world=world, model_dir=model_dir,
-                device_transform=getattr(layer, "dev_transform", False))
+                device_transform=getattr(layer, "dev_transform", False),
+                solver_param=solver_param)
         if layer.lp.type == "HDF5Data":
             return HDF5Feeder(layer.lp, rank=rank, world=world,
                               model_dir=model_dir)
@@ -416,6 +430,8 @@ def cmd_train(args) -> int:
         if reduction.apply_tpu_overlap_flags(os.environ):
             log.info("TPU overlap flags appended to LIBTPU_INIT_ARGS: %s",
                      " ".join(reduction.tpu_overlap_flags()))
+    if args.decoded_cache_mb:
+        sp.decoded_cache_mb = args.decoded_cache_mb
     if args.precision:
         sp.precision = args.precision
     if args.loss_scale >= 0:
@@ -495,7 +511,8 @@ def cmd_train(args) -> int:
     import jax as _jax
     feeder = _build_feeders(solver.net, "TRAIN",
                             rank=_jax.process_index(),
-                            world=_jax.process_count())
+                            world=_jax.process_count(),
+                            solver_param=sp)
     if feeder is None:
         if not args.synthetic:
             log.error("net has no Data layer; pass -synthetic to train on "
@@ -510,7 +527,7 @@ def cmd_train(args) -> int:
     if solver.test_nets:
         tf = []
         for tnet in solver.test_nets:
-            f = _build_feeders(tnet, "TEST")
+            f = _build_feeders(tnet, "TEST", solver_param=sp)
             if f is None:
                 feeds_t = _synthetic_feed(tnet, seed=1)
                 tf.append(lambda it, feeds_t=feeds_t: feeds_t)

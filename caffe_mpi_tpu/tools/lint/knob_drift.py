@@ -55,6 +55,7 @@ KNOBS = (
     "loss_scale",       # ISSUE 9: static/dynamic bf16 loss scaling
     "loss_scale_window",  # ISSUE 9: clean steps before scale regrowth
     "serve_dtype",      # ISSUE 9: bf16 serving bucket programs
+    "decoded_cache_mb",  # ISSUE 10: bounded decoded-record cache tier
 )
 
 CONFIG_FILE = os.path.join("caffe_mpi_tpu", "proto", "config.py")

@@ -1,0 +1,391 @@
+"""Native decode plane tests (ISSUE 10): JPEG/PNG parity vs PIL, the
+fused decode->transform entry point vs the numpy reference on identical
+augmentation decisions, the decoded-record cache tier, corrupt-record
+quarantine through the native path, and graceful PIL fallback.
+
+Parity contract (native/decode.cc): PNG is BITWISE equal to PIL — the
+format is lossless, any correct decoder agrees. JPEG is allowed 1 LSB
+per pixel: IDCT implementations may legally differ between libjpeg
+builds (on this image both PIL's bundled and the system libjpeg are
+turbo and agree bitwise; the contract keeps the test portable).
+"""
+
+import io
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from caffe_mpi_tpu import native
+from caffe_mpi_tpu.data import DataTransformer, Feeder
+from caffe_mpi_tpu.data import decode as dmod
+from caffe_mpi_tpu.data.datasets import (DecodedCacheDataset,
+                                         ImageFolderDataset,
+                                         encode_datum_image, open_dataset)
+from caffe_mpi_tpu.data.lmdb_io import write_lmdb
+from caffe_mpi_tpu.proto import TransformationParameter
+from caffe_mpi_tpu.utils.resilience import RecordIntegrityError
+
+M64 = (1 << 64) - 1
+
+
+def _sm64(x):
+    """splitmix64 replica (transform_core.h) — the aug-decision oracle."""
+    x = (x + 0x9E3779B97F4A7C15) & M64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & M64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & M64
+    return x ^ (x >> 31)
+
+
+def _pil_chw(data):
+    from PIL import Image
+    img = np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
+    return img[:, :, ::-1].transpose(2, 0, 1)
+
+
+def _encode(img_hwc_rgb, fmt, **kw):
+    from PIL import Image
+    b = io.BytesIO()
+    Image.fromarray(img_hwc_rgb).save(b, fmt, **kw)
+    return b.getvalue()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built():
+    if not native.available():
+        script = os.path.join(os.path.dirname(native.__file__), "build.sh")
+        try:
+            subprocess.run(["sh", script], check=True, capture_output=True)
+        except (OSError, subprocess.CalledProcessError):
+            pytest.skip("native toolchain unavailable")
+        native._TRIED = False  # re-probe
+    if not (native.available() and native.decode_available()):
+        pytest.skip("native decode plane unavailable (no libjpeg/libpng "
+                    "at build time) — PIL fallback covers production")
+
+
+@pytest.fixture(autouse=True)
+def clean_env(monkeypatch):
+    monkeypatch.delenv("CAFFE_NATIVE_DECODE", raising=False)
+    dmod.STATS.reset()
+
+
+class TestDecodeParity:
+    def test_png_bitwise_vs_pil(self, rng):
+        img = rng.randint(0, 256, (33, 47, 3)).astype(np.uint8)
+        data = _encode(img, "PNG")
+        assert native.decode_probe(data) == (33, 47)
+        nat = native.decode_image_native(data)
+        np.testing.assert_array_equal(nat, _pil_chw(data))
+
+    def test_jpeg_within_1lsb_vs_pil(self, rng):
+        img = rng.randint(0, 256, (64, 48, 3)).astype(np.uint8)
+        for quality in (70, 95):
+            data = _encode(img, "JPEG", quality=quality)
+            nat = native.decode_image_native(data)
+            pil = _pil_chw(data)
+            assert nat.shape == pil.shape == (3, 64, 48)
+            # IDCT variance bound — bitwise on this image (both turbo)
+            assert np.abs(nat.astype(int) - pil.astype(int)).max() <= 1
+
+    def test_gray_jpeg_and_palette_png_expand_like_pil(self, rng):
+        from PIL import Image
+        img = rng.randint(0, 256, (20, 24, 3)).astype(np.uint8)
+        b = io.BytesIO()
+        Image.fromarray(img).convert("L").save(b, "JPEG")
+        gray = b.getvalue()
+        assert np.abs(native.decode_image_native(gray).astype(int)
+                      - _pil_chw(gray).astype(int)).max() <= 1
+        b = io.BytesIO()
+        Image.fromarray(img).convert(
+            "P", palette=Image.ADAPTIVE).save(b, "PNG")
+        pal = b.getvalue()
+        np.testing.assert_array_equal(native.decode_image_native(pal),
+                                      _pil_chw(pal))
+
+    def test_unsupported_variants_decline_to_pil(self, rng):
+        img = rng.randint(0, 256, (10, 10, 3)).astype(np.uint8)
+        rgba = np.dstack([img, img[:, :, 0]])
+        alpha_png = _encode(rgba, "PNG")
+        assert native.decode_image_native(alpha_png) is None  # declines
+        out = dmod.decode_image(alpha_png)  # plane falls back to PIL
+        assert out.shape[0] == 3
+        s = dmod.STATS.snapshot()
+        assert s["native_fallbacks"] == 1 and s["pil_records"] == 1
+
+    def test_corrupt_bytes_decline_not_crash(self, rng):
+        img = rng.randint(0, 256, (16, 16, 3)).astype(np.uint8)
+        bad = bytearray(_encode(img, "JPEG"))
+        bad[4:40] = b"\x00" * 36
+        assert native.decode_image_native(bytes(bad)) is None
+        with pytest.raises(Exception):
+            dmod.decode_image(bytes(bad))  # PIL also fails -> caller's
+            #                                RecordIntegrityError plane
+
+    def test_env_0_forces_pil(self, rng, monkeypatch):
+        monkeypatch.setenv("CAFFE_NATIVE_DECODE", "0")
+        img = rng.randint(0, 256, (8, 8, 3)).astype(np.uint8)
+        dmod.decode_image(_encode(img, "PNG"))
+        s = dmod.STATS.snapshot()
+        assert s["pil_records"] == 1 and s["native_records"] == 0
+
+
+class TestFusedDecodeTransform:
+    def _mk(self, rng, n=6, h=21, w=17):
+        imgs = rng.randint(0, 256, (n, h, w, 3)).astype(np.uint8)
+        return [_encode(im, "PNG") for im in imgs]
+
+    def test_fused_bitwise_vs_numpy_test_phase(self, rng):
+        """TEST phase: center crop, no RNG — the numpy DataTransformer
+        applied to the (bitwise-identical) decoded pixels must match the
+        fused output bit for bit."""
+        bufs = self._mk(rng)
+        mean = np.asarray([11.0, 22.0, 33.0], np.float32)
+        out = np.empty((len(bufs), 3, 12, 12), np.float32)
+        status = native.decode_transform_batch(
+            bufs, np.arange(len(bufs)), crop=12, mean=mean, scale=0.125,
+            train=False, mirror=False, seed=9, out_h=12, out_w=12, out=out)
+        assert (status == native.DECODE_OK).all()
+        tp = TransformationParameter.from_text(
+            "crop_size: 12 scale: 0.125 mean_value: 11 mean_value: 22 "
+            "mean_value: 33")
+        tf = DataTransformer(tp, "TEST")
+        ref = np.stack([tf(_pil_chw(b)) for b in bufs])
+        np.testing.assert_array_equal(out, ref)
+
+    def test_fused_bitwise_vs_numpy_train_phase(self, rng):
+        """TRAIN phase: replicate the splitmix64 decisions (the SAME
+        keys the classic native transform uses) and apply the reference
+        float32 arithmetic in numpy — bitwise."""
+        h, w, crop, seed = 21, 17, 12, 77
+        bufs = self._mk(rng, h=h, w=w)
+        ids = np.asarray([100, 205, 3, 44, 9999, 123456], np.int64)
+        mean = np.asarray([5.0, 6.0, 7.0], np.float32)
+        scale = np.float32(0.25)
+        out = np.empty((len(bufs), 3, crop, crop), np.float32)
+        status = native.decode_transform_batch(
+            bufs, ids, crop=crop, mean=mean, scale=float(scale),
+            train=True, mirror=True, seed=seed, out_h=crop, out_w=crop,
+            out=out)
+        assert (status == native.DECODE_OK).all()
+        for k, (buf, rid) in enumerate(zip(bufs, ids)):
+            img = _pil_chw(buf)
+            r = _sm64(seed ^ int(rid))
+            oh = r % (h - crop + 1)
+            r = _sm64(r)
+            ow = r % (w - crop + 1)
+            r = _sm64(r)
+            mir = r & 1
+            ref = (img[:, oh:oh + crop, ow:ow + crop].astype(np.float32)
+                   - mean[:, None, None]) * scale
+            if mir:
+                ref = ref[:, :, ::-1]
+            np.testing.assert_array_equal(out[k], ref)
+
+    def test_fused_equals_decode_then_transform_batch(self, rng):
+        """The two native entry points share transform_core.h — same
+        pixels in, bitwise-same batch out."""
+        bufs = self._mk(rng, n=4)
+        ids = np.arange(4, dtype=np.int64) + 31
+        out = np.empty((4, 3, 10, 10), np.float32)
+        status = native.decode_transform_batch(
+            bufs, ids, crop=10, scale=1.0, train=True, mirror=True,
+            seed=5, out_h=10, out_w=10, out=out, num_threads=3)
+        assert (status == native.DECODE_OK).all()
+        decoded = np.stack([native.decode_image_native(b) for b in bufs])
+        ref = native.transform_batch(decoded, ids, crop=10, train=True,
+                                     mirror=True, seed=5)
+        np.testing.assert_array_equal(out, ref)
+
+    def test_decode_only_mode_fills_staging(self, rng):
+        bufs = self._mk(rng, n=3, h=9, w=8)
+        stack = np.zeros((3, 3, 9, 8), np.uint8)
+        status = native.decode_transform_batch(
+            bufs, np.arange(3), out_h=9, out_w=8, out=None,
+            decoded_out=[stack[i] for i in range(3)])
+        assert (status == native.DECODE_OK).all()
+        for i, b in enumerate(bufs):
+            np.testing.assert_array_equal(stack[i], _pil_chw(b))
+
+
+class TestFeederFused:
+    def _db(self, tmp_path, rng, n=24, codec="png", hw=(30, 26)):
+        imgs = rng.randint(0, 256, (n, 3, *hw)).astype(np.uint8)
+        path = str(tmp_path / "db")
+        write_lmdb(path, [(f"{i:08d}".encode(),
+                           encode_datum_image(imgs[i], i % 7, codec))
+                          for i in range(n)])
+        return path
+
+    def _tp(self):
+        return TransformationParameter.from_text(
+            "crop_size: 20 mirror: true scale: 0.5 mean_value: 1 "
+            "mean_value: 2 mean_value: 3")
+
+    def test_fused_feeder_bitwise_vs_pil_path(self, tmp_path, rng,
+                                              monkeypatch):
+        """PNG records (decode bitwise either way): the fused batch must
+        equal the CAFFE_NATIVE_DECODE=0 (pre-ISSUE-10, PIL) batch bit
+        for bit — same aug decisions, same record->slot striping."""
+        path = self._db(tmp_path, rng)
+        batches = {}
+        for env in ("0", "1"):
+            monkeypatch.setenv("CAFFE_NATIVE_DECODE", env)
+            f = Feeder(open_dataset("LMDB", path),
+                       DataTransformer(self._tp(), "TRAIN", seed=4),
+                       batch_size=8, threads=1, shuffle=True)
+            batches[env] = [f._build_batch_inner(i) for i in range(3)]
+            if env == "1":
+                assert f._fused_ok is True
+            f.close()
+        for a, b in zip(batches["0"], batches["1"]):
+            np.testing.assert_array_equal(a["data"], b["data"])
+            np.testing.assert_array_equal(a["label"], b["label"])
+
+    def test_decoded_cache_epoch2_bitwise_zero_decodes(self, tmp_path,
+                                                       rng, monkeypatch):
+        """Epoch 2 over the cached dataset: bitwise-equal batches with
+        ZERO decode calls (counter-asserted). TEST phase so the
+        transform is deterministic across epochs (TRAIN augmentation
+        keys on the flat index by design)."""
+        monkeypatch.setenv("CAFFE_NATIVE_DECODE", "1")
+        path = self._db(tmp_path, rng)
+        tp = TransformationParameter.from_text("crop_size: 20")
+        ds = DecodedCacheDataset(open_dataset("LMDB", path), 64.0)
+        f = Feeder(ds, DataTransformer(tp, "TEST", seed=4),
+                   batch_size=8, threads=1)
+        ep1 = [f._build_batch_inner(i) for i in range(3)]   # epoch 1
+        s1 = dmod.STATS.snapshot()
+        assert s1["decode_calls"] == 24 and s1["cache_inserts"] == 24
+        ep2 = [f._build_batch_inner(i) for i in range(3, 6)]  # epoch 2
+        s2 = dmod.STATS.snapshot()
+        assert s2["decode_calls"] == s1["decode_calls"]  # ZERO new
+        assert s2["cache_hits"] >= 24
+        for a, b in zip(ep1, ep2):
+            np.testing.assert_array_equal(a["data"], b["data"])
+            np.testing.assert_array_equal(a["label"], b["label"])
+        f.close()
+
+    def test_corrupt_jpeg_quarantines_not_crashes(self, tmp_path, rng,
+                                                  monkeypatch):
+        monkeypatch.setenv("CAFFE_NATIVE_DECODE", "1")
+        n = 16
+        imgs = rng.randint(0, 256, (n, 3, 24, 24)).astype(np.uint8)
+        recs = [(f"{i:08d}".encode(),
+                 encode_datum_image(imgs[i], i, "jpeg"))
+                for i in range(n)]
+        bad = bytearray(recs[5][1])
+        off = bytes(bad).find(b"\xff\xd8\xff")
+        bad[off + 4:off + 40] = b"\x00" * 36
+        recs[5] = (recs[5][0], bytes(bad))
+        path = str(tmp_path / "db")
+        write_lmdb(path, recs)
+        # direct read: the corrupt payload is a RecordIntegrityError
+        # (native declines -> PIL fails -> quarantine signal), NOT a
+        # native crash
+        ds = open_dataset("LMDB", path)
+        with pytest.raises(RecordIntegrityError):
+            ds.get(5)
+        # through the fused Feeder: record 5 is substituted by its
+        # deterministic neighbor and journaled
+        f = Feeder(open_dataset("LMDB", path),
+                   DataTransformer(self._tp(), "TRAIN", seed=4),
+                   batch_size=8, threads=1)
+        f._build_batch_inner(0)
+        assert 5 in f._quarantined and f._sub_cache.get(5) == 6
+        f.close()
+
+    def test_pil_fallback_when_native_absent(self, tmp_path, rng,
+                                             monkeypatch):
+        """Simulate an unbuilt .so: the plane reports unavailable, the
+        Feeder stays classic, batches still assemble via PIL."""
+        path = self._db(tmp_path, rng, n=8)
+        monkeypatch.setattr(native, "_LIB", None)
+        monkeypatch.setattr(native, "_TRIED", True)
+        assert not dmod.native_enabled()
+        f = Feeder(open_dataset("LMDB", path),
+                   DataTransformer(self._tp(), "TRAIN", seed=4),
+                   batch_size=8, threads=1)
+        batch = f._build_batch_inner(0)
+        assert batch["data"].shape == (8, 3, 20, 20)
+        s = dmod.STATS.snapshot()
+        assert s["pil_records"] >= 8 and s["fused_records"] == 0
+        f.close()
+        # forcing native with the plane absent is a loud error, not a
+        # silent PIL run
+        monkeypatch.setenv("CAFFE_NATIVE_DECODE", "1")
+        with pytest.raises(RuntimeError):
+            dmod.native_enabled()
+
+
+class TestResizeAndImageFolder:
+    def test_native_bilinear_vs_numpy_reference(self, rng):
+        """decode_resize vs a float64 numpy transcription of the
+        cv::resize INTER_LINEAR convention (half-pixel centers, clamped
+        edges, round-to-nearest) — within 1 LSB of rounding."""
+        img = rng.randint(0, 256, (19, 23, 3)).astype(np.uint8)
+        data = _encode(img, "PNG")
+        oh, ow = 11, 29
+        nat = native.decode_resize_native(data, oh, ow)
+        chw = _pil_chw(data).astype(np.float64)
+        h, w = chw.shape[1:]
+        fy = np.clip((np.arange(oh) + 0.5) * (h / oh) - 0.5, 0, None)
+        fx = np.clip((np.arange(ow) + 0.5) * (w / ow) - 0.5, 0, None)
+        y0 = np.minimum(fy.astype(int), h - 1)
+        x0 = np.minimum(fx.astype(int), w - 1)
+        y1 = np.minimum(y0 + 1, h - 1)
+        x1 = np.minimum(x0 + 1, w - 1)
+        wy = (fy - y0)[None, :, None]
+        wx = (fx - x0)[None, None, :]
+        p00 = chw[:, y0][:, :, x0]
+        p01 = chw[:, y0][:, :, x1]
+        p10 = chw[:, y1][:, :, x0]
+        p11 = chw[:, y1][:, :, x1]
+        top = p00 + wx * (p01 - p00)
+        bot = p10 + wx * (p11 - p10)
+        ref = np.floor(top + wy * (bot - top) + 0.5)
+        assert np.abs(nat.astype(np.float64) - ref).max() <= 1
+
+    def test_identity_resize_is_decode(self, rng):
+        img = rng.randint(0, 256, (14, 15, 3)).astype(np.uint8)
+        data = _encode(img, "PNG")
+        np.testing.assert_array_equal(
+            native.decode_resize_native(data, 14, 15), _pil_chw(data))
+
+    def test_image_folder_native_route(self, tmp_path, rng, monkeypatch):
+        from PIL import Image
+        imgs = rng.randint(0, 256, (4, 3, 18, 18)).astype(np.uint8)
+        lines = []
+        for i in range(4):
+            p = tmp_path / f"im{i}.png"
+            Image.fromarray(imgs[i].transpose(1, 2, 0)).save(str(p))
+            lines.append(f"im{i}.png {i}")
+        src = tmp_path / "index.txt"
+        src.write_text("\n".join(lines) + "\n")
+        ds = ImageFolderDataset(str(src), root=str(tmp_path))
+        monkeypatch.setenv("CAFFE_NATIVE_DECODE", "1")
+        arr, label = ds.get(2)
+        assert label == 2
+        s = dmod.STATS.snapshot()
+        assert s["native_records"] == 1
+        monkeypatch.setenv("CAFFE_NATIVE_DECODE", "0")
+        ref, _ = ds.get(2)
+        np.testing.assert_array_equal(arr, ref[::-1][::-1])  # both BGR CHW
+        np.testing.assert_array_equal(arr, ref)
+        # resize route: shape + native engagement (bilinear conventions
+        # differ from PIL's antialiased BILINEAR by design — the native
+        # path follows the reference's cv::resize)
+        ds2 = ImageFolderDataset(str(src), root=str(tmp_path),
+                                 new_height=9, new_width=12)
+        monkeypatch.setenv("CAFFE_NATIVE_DECODE", "1")
+        arr2, _ = ds2.get(1)
+        assert arr2.shape == (3, 9, 12)
+        # grayscale stays on the PIL path (luma weights) on either env
+        ds3 = ImageFolderDataset(str(src), root=str(tmp_path),
+                                 is_color=False)
+        g1, _ = ds3.get(0)
+        monkeypatch.setenv("CAFFE_NATIVE_DECODE", "0")
+        g0, _ = ds3.get(0)
+        np.testing.assert_array_equal(g1, g0)
+        assert g1.shape == (1, 18, 18)

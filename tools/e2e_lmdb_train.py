@@ -30,16 +30,23 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _ROOT)
 
 
-def build_db(workdir: str, n: int, shape=(3, 256, 256)) -> tuple[str, str]:
+def build_db(workdir: str, n: int, shape=(3, 256, 256),
+             codec: str = "jpeg") -> tuple[str, str]:
     """Synthetic separable-cluster LMDB + mean file (cached across runs:
-    rebuilding 1k 256x256 records costs ~10s of host time)."""
+    rebuilding 1k 256x256 records costs ~10s of host time). Records are
+    JPEG-encoded Datums by default (ISSUE 10) — the ImageNet-convert
+    layout, where the host pipeline pays a real decode per record;
+    `codec='none'` writes raw datums (the pre-ISSUE-10 layout). The mean
+    file is computed over the PRE-encode pixels (the ~1 LSB JPEG
+    round-trip shift is noise at training scale)."""
     import numpy as np
     from examples.common import synthetic_clusters
-    from caffe_mpi_tpu.data.datasets import encode_datum
+    from caffe_mpi_tpu.data.datasets import encode_datum, encode_datum_image
     from caffe_mpi_tpu.data.lmdb_io import write_lmdb
     from caffe_mpi_tpu.io import save_blob_binaryproto
 
-    db = os.path.join(workdir, f"e2e_train_lmdb_{n}")
+    tag = "" if codec == "none" else f"_{codec}"
+    db = os.path.join(workdir, f"e2e_train_lmdb_{n}{tag}")
     mean = os.path.join(workdir, f"e2e_mean_{n}.binaryproto")
     if os.path.isdir(db) and os.path.exists(mean):
         return db, mean
@@ -57,8 +64,12 @@ def build_db(workdir: str, n: int, shape=(3, 256, 256)) -> tuple[str, str]:
                                               classes=10)
             mean_acc[...] += imgs.sum(axis=0, dtype=np.float64)
             for i in range(k):
-                yield (f"{lo + i:08d}".encode(),
-                       encode_datum(imgs[i], int(labels[i])))
+                key = f"{lo + i:08d}".encode()
+                if codec == "none":
+                    yield key, encode_datum(imgs[i], int(labels[i]))
+                else:
+                    yield key, encode_datum_image(imgs[i], int(labels[i]),
+                                                  codec)
 
     write_lmdb(db, records())
     save_blob_binaryproto(mean, (mean_acc / n).astype(np.float32)[None])
@@ -108,6 +119,21 @@ def main() -> int:
                    "guard armed, reporting skipped_steps + guard_syncs "
                    "so the guard's ~zero overhead is measured on the "
                    "real pipeline; 0 = unguarded")
+    # ingestion knobs (ISSUE 10)
+    p.add_argument("--codec", default="jpeg",
+                   choices=["jpeg", "png", "none"],
+                   help="record encoding for the synthetic LMDB "
+                   "(default jpeg — the host pipeline pays a real "
+                   "decode per record; 'none' = raw datums, the "
+                   "pre-ISSUE-10 layout)")
+    p.add_argument("--decoded-cache-mb", type=float, default=0.0,
+                   help="decoded-record cache budget (solver "
+                   "decoded_cache_mb); epochs after the first skip "
+                   "read+crc+decode for the cached span")
+    p.add_argument("--require-native-decode", action="store_true",
+                   help="exit nonzero unless the native decode plane "
+                   "actually decoded records this run (the "
+                   "tpu_validation assertion)")
     args = p.parse_args()
 
     if args.max_restarts > 0 \
@@ -142,7 +168,7 @@ def main() -> int:
             anomaly_action="rewind")
 
     os.makedirs(args.workdir, exist_ok=True)
-    db, mean = build_db(args.workdir, args.records)
+    db, mean = build_db(args.workdir, args.records, codec=args.codec)
 
     import jax
     import numpy as np
@@ -195,6 +221,11 @@ def main() -> int:
     # corrupt LMDB record would quarantine via the crc sidecar the
     # build_db writer published (journal next to the snapshots)
     sp.train_guard = bool(args.train_guard)
+    # ingestion (ISSUE 10): optional decoded-record cache tier; the
+    # Feeder engages the fused native decode path on its own when the
+    # records are encoded and native/decode.cc is built
+    if args.decoded_cache_mb:
+        sp.decoded_cache_mb = args.decoded_cache_mb
     from caffe_mpi_tpu.utils import resilience
     resilience.QUARANTINE.configure(sp.snapshot_prefix
                                     + ".quarantine.json")
@@ -202,9 +233,10 @@ def main() -> int:
     solver = Solver(sp)
     if args.resume == "auto":
         solver.restore_auto()
-    feeder = _build_feeders(solver.net, "TRAIN")
+    feeder = _build_feeders(solver.net, "TRAIN", solver_param=sp)
     assert feeder is not None, "Data layer did not produce a feeder"
-    test_feeder = _build_feeders(solver.test_nets[0], "TEST")
+    test_feeder = _build_feeders(solver.test_nets[0], "TEST",
+                                 solver_param=sp)
 
     eval_line = ""
     try:
@@ -239,6 +271,19 @@ def main() -> int:
                 f"test_dispatches_per_pass, "
                 f"{(solver.eval_stall_ms - ts0) / passes:.1f} "
                 f"eval_stall_ms")
+
+        # ISSUE 10 both-sides measurement: host-pipeline SUPPLY rate
+        # (per-worker batch-build throughput over the same LMDB,
+        # prefetch queue bypassed so lookahead can't flatter it) vs the
+        # train loop's CONSUMPTION rate (the e2e img/s above). Supply
+        # must exceed consumption or the chips starve. Batches are pure
+        # functions of their index — rebuilding consumed indices is
+        # side-effect-free.
+        k_sup = 4
+        t0 = time.perf_counter()
+        for i in range(k_sup):
+            feeder._build_batch_inner(i)
+        host_img_s = args.batch * k_sup / (time.perf_counter() - t0)
     except resilience.NumericAnomalyError as e:
         # mirror cli.cmd_train: exit 88 so the supervisor above (or
         # tpu_validation's harness) applies the rewind policy instead
@@ -270,6 +315,33 @@ def main() -> int:
           "transform/staging -> device super-batch (prefetched in a "
           "worker thread) -> fused K-step scan with non-finite guard; "
           "eval passes fused+async (ISSUE 2)")
+
+    # ISSUE 10 ingest report: decode-plane counters + both sides of the
+    # feeding equation, printed AND journaled into the run JSON (the
+    # tpu_validation e2e stage asserts native_decodes > 0 there)
+    from caffe_mpi_tpu.data import decode as _decode
+    ingest = _decode.STATS.snapshot()
+    ingest.update({
+        "codec": args.codec,
+        "host_img_s": round(host_img_s, 1),
+        "train_img_s": round(img_s, 1),
+        "host_feeds_train": bool(host_img_s >= img_s),
+    })
+    native_decodes = ingest["native_records"] + ingest["fused_records"]
+    resilience.write_run_manifest(sp.snapshot_prefix, kind="e2e_ingest",
+                                  iteration=solver.iter, ingest=ingest)
+    import json
+    print("e2e-ingest: " + json.dumps(ingest))
+    verdict = ("OK — host outruns the chip" if host_img_s >= img_s
+               else "HOST-BOUND")
+    print(f"e2e-ingest: host pipeline supplies {host_img_s:.0f} img/s vs "
+          f"train consuming {img_s:.0f} img/s ({verdict}; "
+          f"{native_decodes} native decodes, "
+          f"{ingest['pil_records']} PIL)")
+    if args.require_native_decode and native_decodes == 0:
+        print("e2e-ingest: FAIL — native decode plane never engaged "
+              "(--require-native-decode)", file=sys.stderr)
+        return 1
     return 0
 
 

@@ -204,9 +204,14 @@ for causal in (False, True):
                 600, log)
             # flagship fed from a REAL LMDB through the host pipeline —
             # the e2e img/s vs the synthetic-feed bench quantifies the
-            # pipeline cost on hardware (VERDICT r4 weak #3)
+            # pipeline cost on hardware (VERDICT r4 weak #3). The LMDB
+            # is JPEG-encoded (ISSUE 10) and the stage FAILS unless the
+            # native decode plane actually decoded records (counter in
+            # the run JSON, e2e-ingest line) — a silent PIL fallback on
+            # hardware would invalidate the ingestion numbers
             run("train-alexnet-lmdb",
-                [py, "tools/e2e_lmdb_train.py"], 900, log)
+                [py, "tools/e2e_lmdb_train.py",
+                 "--require-native-decode"], 900, log)
     os.replace(partial, final)
     print("summary written to tpu_validation.log")
     return 0

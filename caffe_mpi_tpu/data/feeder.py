@@ -15,6 +15,15 @@ calculation: global record index for (iteration, slot) is
 which reproduces CursorManager's deterministic striping without cursors.
 Epoch shuffling uses a seed-fixed permutation per epoch (DataCache shuffle,
 data_reader.hpp:55-101).
+
+Multi-host (ISSUE 11): under `caffe train -hosts N` the CLI passes
+rank = jax.process_index() and world = jax.process_count(), so the same
+formula IS the per-host record sharding — disjoint, exhaustive, and a
+pure function of (iteration, rank, slot), which keeps crc verification
+and quarantine substitution replay-identical on every host and across
+supervised restarts. Each host journals quarantines to its own
+`<prefix>.quarantine.r<k>.json` (resilience.quarantine_journal_path);
+rank 0 merges them at snapshot time.
 """
 
 from __future__ import annotations

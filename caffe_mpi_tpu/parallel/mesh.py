@@ -118,18 +118,249 @@ def mark_varying(x, axis_name: str | None = None, *, like=None):
     return x  # pre-vma jax: nothing to mark
 
 
-def init_distributed(coordinator: str | None = None, num_processes: int | None = None,
-                     process_id: int | None = None) -> None:
+def resolve_cluster(sp=None, host_id: int | None = None):
+    """Resolve the elastic-cluster shape (ISSUE 11) from the solver
+    knobs (`hosts` / `coordinator`) with env fallbacks
+    (`CAFFE_TPU_NUM_HOSTS` / `CAFFE_TPU_COORDINATOR` /
+    `CAFFE_TPU_HOST_ID`) — the reference reads the same facts from
+    mpirun's environment (clusters.cpp:8-45). Returns
+    (world, coordinator, rank); world <= 1 means single-host (the
+    other two are then unchecked). An incomplete multi-host config
+    raises resilience.ClusterError — a bounded, journalable failure
+    instead of a later hang."""
+    import os
+
+    from ..utils import resilience
+    world = int(getattr(sp, "hosts", 0) or 0) if sp is not None else 0
+    if world <= 0:
+        world = int(os.environ.get("CAFFE_TPU_NUM_HOSTS", "0") or 0)
+    coordinator = (str(getattr(sp, "coordinator", "") or "")
+                   if sp is not None else "")
+    if not coordinator:
+        coordinator = os.environ.get("CAFFE_TPU_COORDINATOR", "")
+    rank = host_id if host_id is not None and host_id >= 0 else int(
+        os.environ.get("CAFFE_TPU_HOST_ID", "-1") or -1)
+    if world > 1:
+        if not coordinator:
+            raise resilience.ClusterError(
+                f"hosts={world} but no coordinator: set the solver "
+                "`coordinator` knob, -coordinator, or "
+                "CAFFE_TPU_COORDINATOR")
+        if not 0 <= rank < world:
+            raise resilience.ClusterError(
+                f"hosts={world} needs a host id in [0, {world}): set "
+                "-host_id or CAFFE_TPU_HOST_ID")
+    return world, coordinator, rank
+
+
+def init_distributed(coordinator: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None, *,
+                     attempts: int = 3, base_delay: float = 1.0,
+                     timeout_s: float | None = None) -> None:
     """Multi-host init (reference Clusters::Init / MPI_Init,
-    clusters.cpp:8-12). On single-host this is a no-op; under a multi-host
-    launcher either the TPU runtime autodetects or the caller passes
-    coordinator/num_processes/process_id explicitly."""
-    if num_processes is not None and num_processes > 1:
-        jax.distributed.initialize(coordinator_address=coordinator,
-                                   num_processes=num_processes,
-                                   process_id=process_id)
-        log.info("jax.distributed initialized: process %d/%d",
-                 jax.process_index(), jax.process_count())
+    clusters.cpp:8-12). On single-host this is a no-op; under a
+    multi-host launcher either the TPU runtime autodetects or the
+    caller passes coordinator/num_processes/process_id explicitly.
+
+    Hardened (ISSUE 11): each attempt is bounded by
+    `initialization_timeout` (default from CAFFE_TPU_INIT_TIMEOUT, 60 s
+    — the in-library connect loop already retries until then, so one
+    attempt absorbs a coordinator that is merely *restarting*), failed
+    attempts back off exponentially, and exhaustion raises
+    resilience.ClusterError — a missing coordinator is a bounded,
+    journaled exit-87 failure, never a hang. The `coordinator_down`
+    fault site fails the first `count` attempts for the recovery
+    suite."""
+    if num_processes is None or num_processes <= 1:
+        return
+    import inspect
+    import os
+    import time
+
+    from ..utils import resilience
+    from ..utils.resilience import FAULTS
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("CAFFE_TPU_INIT_TIMEOUT", "60")
+                          or 60)
+    kw = {}
+    if "initialization_timeout" in inspect.signature(
+            jax.distributed.initialize).parameters:
+        kw["initialization_timeout"] = int(max(timeout_s, 1))
+    delay = base_delay
+    last: Exception | None = None
+    for attempt in range(max(attempts, 1)):
+        try:
+            FAULTS.maybe_raise(
+                "coordinator_down", RuntimeError,
+                f"injected coordinator outage (attempt {attempt + 1})")
+            jax.distributed.initialize(coordinator_address=coordinator,
+                                       num_processes=num_processes,
+                                       process_id=process_id, **kw)
+            log.info("jax.distributed initialized: process %d/%d "
+                     "(coordinator %s, attempt %d)", jax.process_index(),
+                     jax.process_count(), coordinator, attempt + 1)
+            return
+        except Exception as e:  # noqa: BLE001 — every failure class
+            # (gRPC unavailable, timeout, duplicate registration
+            # against a dying coordinator) retries the same way
+            last = e
+            try:
+                jax.distributed.shutdown()
+            except Exception:  # noqa: BLE001 — partial init state
+                pass
+            if attempt + 1 >= max(attempts, 1):
+                break
+            log.warning("distributed init attempt %d/%d failed (%s); "
+                        "retrying in %.1fs", attempt + 1, attempts, e,
+                        delay)
+            time.sleep(delay)
+            delay = min(delay * 2, 30.0)
+    raise resilience.ClusterError(
+        f"distributed init failed after {attempts} attempt(s) against "
+        f"coordinator {coordinator!r}: {last}") from last
+
+
+def shutdown_distributed() -> None:
+    """Best-effort jax.distributed teardown (after the exit barrier):
+    rank 0's coordination service must not die underneath a peer that
+    is still mid-KV-call."""
+    try:
+        jax.distributed.shutdown()
+    except Exception:  # noqa: BLE001 — already down is fine
+        pass
+
+
+def _cluster_client():
+    """The live coordination-service client, or None outside a
+    jax.distributed run. jax 0.4.x exposes it only via the private
+    global_state (the public accessor postdates this pin)."""
+    try:
+        from jax._src import distributed
+        return distributed.global_state.client
+    except Exception:  # noqa: BLE001 — no distributed runtime
+        return None
+
+
+def cluster_barrier(name: str, timeout_s: float = 600.0) -> bool:
+    """All-hosts sync point on the coordination service (snapshot
+    commit, end-of-training). True on success; False on timeout or a
+    dead service — callers map False to a journaled EXIT_CLUSTER, the
+    bounded alternative to waiting forever on a host that died."""
+    client = _cluster_client()
+    if client is None:
+        return True
+    try:
+        client.wait_at_barrier(name, int(timeout_s * 1000))
+        return True
+    except Exception as e:  # noqa: BLE001 — timeout and UNAVAILABLE alike
+        log.error("cluster barrier %r failed: %s", name, e)
+        return False
+
+
+def cluster_kv_set(key: str, value: str) -> bool:
+    """Publish a value on the coordination service's KV store (rank 0's
+    resume decision). Best-effort: False when the service is gone."""
+    client = _cluster_client()
+    if client is None:
+        return False
+    try:
+        client.key_value_set(key, value)
+        return True
+    except Exception as e:  # noqa: BLE001
+        log.error("cluster kv set %r failed: %s", key, e)
+        return False
+
+
+def cluster_kv_get(key: str, timeout_s: float = 120.0) -> str | None:
+    """Blocking KV read (peers waiting for rank 0's resume decision).
+    None on timeout / dead service."""
+    client = _cluster_client()
+    if client is None:
+        return None
+    try:
+        return client.blocking_key_value_get(key, int(timeout_s * 1000))
+    except Exception as e:  # noqa: BLE001
+        log.error("cluster kv get %r failed: %s", key, e)
+        return None
+
+
+class KVBeatTransport:
+    """Heartbeat transport over the jax.distributed KV store (the
+    channel the cluster already trusts for init — no extra
+    infrastructure, works without shared storage). Beats are
+    set-once sequence-numbered keys (the coordination service forbids
+    overwrite); each publish prunes its own beats a window behind, so
+    the store stays bounded. Readers use `latest_seq` (a directory
+    listing), NEVER an exact key — a reader that armed late (the
+    first-contact grace covers minutes of jit-compile skew) or fell
+    behind must catch up from whatever history remains, not wedge on a
+    pruned sequence number. A dead coordinator makes every call fail,
+    which the HostHeartbeat treats as silence — the whole cluster then
+    exits 87 within one deadline, the coordinated-restart property."""
+
+    _PREFIX = "caffe_hb"
+    _PRUNE_LAG = 16
+
+    def __init__(self, client=None):
+        self._client = client if client is not None else _cluster_client()
+        if self._client is None:
+            raise _no_cluster_error()
+
+    def _key(self, host: int, seq) -> str:
+        return f"{self._PREFIX}/{int(host)}/{seq}"
+
+    def publish(self, host: int, seq: int) -> None:
+        self._client.key_value_set(self._key(host, seq), "1")
+        if seq >= self._PRUNE_LAG:
+            try:
+                self._client.key_value_delete(
+                    self._key(host, seq - self._PRUNE_LAG))
+            except Exception:  # noqa: BLE001 — pruning is best-effort
+                pass
+
+    def latest_seq(self, host: int) -> int:
+        """Newest beat sequence `host` has published, -1 when none
+        (missing dirs list as empty)."""
+        entries = self._client.key_value_dir_get(
+            f"{self._PREFIX}/{int(host)}/")
+        latest = -1
+        for key, _value in entries:
+            tail = key.rsplit("/", 1)[-1]
+            if tail.isdigit():
+                latest = max(latest, int(tail))
+        return latest
+
+    def farewell(self, host: int) -> None:
+        self._client.key_value_set(self._key(host, "bye"), "1")
+
+    def is_bye(self, host: int) -> bool:
+        try:
+            self._client.blocking_key_value_get(self._key(host, "bye"), 1)
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+
+def _no_cluster_error():
+    from ..utils import resilience
+    return resilience.ClusterError(
+        "no jax.distributed runtime: KVBeatTransport needs "
+        "init_distributed first (or set CAFFE_TPU_HB_DIR for the "
+        "shared-directory transport)")
+
+
+def heartbeat_transport():
+    """The heartbeat channel for this run: the shared-directory
+    transport when CAFFE_TPU_HB_DIR is set (tests, suspect
+    coordination service), else the coordination-service KV store."""
+    import os
+
+    from ..utils import resilience
+    hb_dir = os.environ.get("CAFFE_TPU_HB_DIR", "")
+    if hb_dir:
+        return resilience.DirBeatTransport(hb_dir)
+    return KVBeatTransport()
 
 
 def to_host_array(a, dtype=None) -> np.ndarray:

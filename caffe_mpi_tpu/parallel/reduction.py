@@ -46,6 +46,14 @@ latency-hiding scheduler has independent collectives to hoist
   in compiled HLO text and where they sit in program order, so the
   ≥ `reduce_buckets` collectives-per-step claim (and the overlap-span
   proxy) is checkable with the tunnel down.
+
+Multi-host (ISSUE 11): the bucket psums reduce over the mesh 'data'
+axis, and under `caffe train -hosts N` that axis spans processes — so
+each bucket's collective crosses hosts over DCN with NO change to this
+module, exactly the reference's global (multi-node) NCCL communicator
+(parallel.cpp:166-169) at bucket granularity.
+Solver.reduction_stats() adds the `hosts` /
+`cross_host_collectives_per_step` facts (this module stays jax-free).
 """
 
 from __future__ import annotations

@@ -1075,6 +1075,27 @@ class SolverParameter(Message):
     # jit-compile time a dispatch can trigger. 0 (default) = no
     # watchdog, the reference behavior.
     watchdog_deadline: float = 0.0
+    # TPU-native extension (ISSUE 11, elastic multi-host training —
+    # docs/robustness.md "Multi-host elasticity"): number of host
+    # processes in the cluster (the reference's mpirun -n,
+    # clusters.cpp:8-45). > 1 makes `caffe train` initialize
+    # jax.distributed against `coordinator` (retry/backoff bounded;
+    # failure journals and exits 87) so the device mesh spans every
+    # host, reduce_overlap buckets become cross-host collectives, and
+    # the Feeder stripes records per host. 0/1 (default) = single
+    # process, today's behavior. Env fallbacks: CAFFE_TPU_NUM_HOSTS /
+    # CAFFE_TPU_COORDINATOR / CAFFE_TPU_HOST_ID.
+    hosts: int = 0
+    # coordination-service address (host:port of host 0) for the
+    # multi-host cluster; required when hosts > 1.
+    coordinator: str = ""
+    # cross-host heartbeat deadline in seconds: > 0 (with hosts > 1)
+    # arms host-loss detection on the watchdog monitor thread — a peer
+    # host silent this long is journaled to <prefix>.run.json and the
+    # local worker exits 87 (EXIT_CLUSTER) for the supervisor's
+    # coordinated restart, instead of hanging inside the next
+    # collective. 0 (default) = no heartbeat.
+    host_deadline: float = 0.0
 
 
 # ---------------------------------------------------------------------------

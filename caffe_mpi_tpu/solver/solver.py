@@ -313,6 +313,7 @@ class Solver:
         # wait_snapshots() so a silent half-checkpoint can't pass as
         # success.
         self._watchdog = None
+        self._heartbeat = None  # ISSUE 11: cross-host loss detection
         self._last_snapshot: tuple[int, str] | None = None
         self._snapshot_error: tuple[int, BaseException] | None = None
         # self-healing state (ISSUE 4): the on-device non-finite guard.
@@ -553,14 +554,23 @@ class Solver:
         'implicit' with the fallback reason when reduce_overlap could
         not engage. None when training has no mesh (nothing to
         reduce)."""
+        out = None
         if self._reduction is not None:
-            return self._reduction.stats()
-        if self.mesh is not None:
+            out = self._reduction.stats()
+        elif self.mesh is not None:
             out = {"mode": "implicit", "n_data": self.mesh.n_data}
             if self._reduction_fallback:
                 out["fallback_reason"] = self._reduction_fallback
-            return out
-        return None
+        if out is not None:
+            # ISSUE 11: in a multi-host run the mesh 'data' axis spans
+            # processes, so every per-bucket psum is a CROSS-HOST (DCN)
+            # collective — the reference's global NCCL communicator
+            # (parallel.cpp:166-169) at host granularity
+            hosts = jax.process_count()
+            out["hosts"] = hosts
+            out["cross_host_collectives_per_step"] = (
+                out.get("collectives_per_step", 0) if hosts > 1 else 0)
+        return out
 
     def step_hlo_text(self, feeds: dict) -> str:
         """Optimized HLO of the single-iteration jitted step for one
@@ -1176,15 +1186,44 @@ class Solver:
         if self._watchdog is not None:
             return
         deadline = float(getattr(self.sp, "watchdog_deadline", 0.0) or 0.0)
-        if deadline <= 0:
+        # ISSUE 11: the cross-host heartbeat rides the same monitor
+        # thread (its pulse hook) — a dead peer mid-collective and a
+        # dead tunnel mid-dispatch are the same failure shape, bounded
+        # by the same thread. host_deadline > 0 in a multi-process run
+        # arms it; single-host runs never pay for the check.
+        host_deadline = float(getattr(self.sp, "host_deadline", 0.0)
+                              or 0.0)
+        hb = None
+        if host_deadline > 0 and jax.process_count() > 1:
+            from ..parallel.mesh import heartbeat_transport
+            hb = resilience.HostHeartbeat(
+                heartbeat_transport(), jax.process_index(),
+                jax.process_count(), host_deadline,
+                on_lost=self._host_lost_journal)
+            log.info("cross-host heartbeat armed: %d host(s), %.1fs "
+                     "deadline, %.2fs beat interval (exit %d on a lost "
+                     "peer)", jax.process_count(), host_deadline,
+                     hb.interval, resilience.EXIT_CLUSTER)
+        if deadline <= 0 and hb is None:
             return
+        poll = None
+        if hb is not None:
+            # tick at least twice per beat interval so publishes are
+            # never later than peers' expectations
+            poll = hb.interval / 2.0
+            if deadline > 0:
+                poll = min(poll, max(deadline / 4.0, 0.05))
+        self._heartbeat = hb
         self._watchdog = resilience.DispatchWatchdog(
-            deadline, self._watchdog_journal)
-        log.info("dispatch watchdog armed: %.1fs deadline (journals to %s "
-                 "and exits %d on a stuck dispatch)", deadline,
-                 resilience.run_manifest_path(
-                     self.sp.snapshot_prefix or "snapshot"),
-                 resilience.EXIT_WATCHDOG)
+            deadline if deadline > 0 else float("inf"),
+            self._watchdog_journal, poll=poll,
+            pulse=hb.tick if hb is not None else None)
+        if deadline > 0:
+            log.info("dispatch watchdog armed: %.1fs deadline (journals "
+                     "to %s and exits %d on a stuck dispatch)", deadline,
+                     resilience.run_manifest_path(
+                         self.sp.snapshot_prefix or "snapshot"),
+                     resilience.EXIT_WATCHDOG)
 
     def _guard(self, label: str):
         wd = self._watchdog
@@ -1195,6 +1234,26 @@ class Solver:
         self._journal_run_state(
             f"watchdog:{label}", stalled_s=round(elapsed, 1),
             deadline_s=float(getattr(self.sp, "watchdog_deadline", 0.0)))
+
+    def heartbeat_farewell(self) -> None:
+        """Publish the clean-departure beat (ISSUE 11). Call ONLY after
+        the end-of-training barrier has succeeded — peers then stop
+        expecting beats instead of tripping on shutdown skew. Never
+        called on failure paths: a crashed host must stay mournable."""
+        if self._heartbeat is not None:
+            self._heartbeat.farewell()
+
+    def _host_lost_journal(self, peer: int, elapsed: float) -> None:
+        """Heartbeat on_lost callback (ISSUE 11): record WHICH peer went
+        silent before the monitor hard-exits 87. Critical — every rank
+        journals (non-zero ranks to their own `.r<k>` journal), because
+        the host that noticed first is exactly the forensic fact the
+        operator needs."""
+        self._journal_run_state(
+            f"host_lost:{int(peer)}", critical=True, peer=int(peer),
+            silent_s=round(elapsed, 1),
+            host_deadline_s=float(getattr(self.sp, "host_deadline", 0.0)),
+            exit_code=resilience.EXIT_CLUSTER)
 
     # ------------------------------------------------------------------
     # Self-healing training (ISSUE 4): host side of the on-device guard.
@@ -1293,14 +1352,21 @@ class Solver:
             raise resilience.NumericAnomalyError(
                 boundary_iter, consec, skips, last_bad)
 
-    def _journal_run_state(self, reason: str, **extra) -> None:
+    def _journal_run_state(self, reason: str, critical: bool = False,
+                           **extra) -> None:
         """Write the run manifest: the journal `--resume auto` and the
         operator read after a crash. Best-effort — journaling failures
-        must never take down training."""
-        if self.rank != 0:
+        must never take down training. Rank 0 owns `<prefix>.run.json`;
+        non-zero ranks journal only `critical` cluster events (host
+        loss, ISSUE 11) and to their own `<prefix>.r<k>.run.json` — N
+        hosts racing atomic rewrites of one shared journal would drop
+        each other's last words."""
+        if self.rank != 0 and not critical:
             return
         last_it, last_state = self._last_snapshot or (None, None)
         prefix = self.sp.snapshot_prefix or "snapshot"
+        if self.rank != 0:
+            prefix = f"{prefix}.r{self.rank}"
         try:
             resilience.write_run_manifest(
                 prefix, reason=reason, iter=int(self.iter),
@@ -1515,6 +1581,13 @@ class Solver:
             for q in self._test_feed_queues.values():
                 q.close()
             self._test_feed_queues.clear()
+            # NOTE: no heartbeat farewell here — close() also runs on
+            # FAILURE exits (cmd_train's finally), and a crashing host
+            # marked as a clean departure would stop its peers
+            # monitoring it forever; the CLI publishes the farewell
+            # explicitly after the end-of-training barrier
+            # (heartbeat_farewell), the only place departure is clean.
+            self._heartbeat = None
             if self._watchdog is not None:
                 self._watchdog.stop()
                 self._watchdog = None
@@ -1912,6 +1985,14 @@ class Solver:
         would require a collective in a multi-process run, async mode
         falls back to blocking (collective order then stays identical on
         every rank)."""
+        if str(self.sp.snapshot_format).upper() == "ORBAX":
+            # sharded native checkpoints (ISSUE 11): the orbax save is
+            # collective in a multi-host run (every rank streams its
+            # own shards) and orbax owns its write pipeline — it always
+            # runs blocking here so collective order stays
+            # rank-identical, like the collective-gather fallback below
+            self.wait_snapshots()
+            return self.snapshot_native()
         if not block and FAULTS.fire("snapshot_sync") is not None:
             # test-only: force blocking writes so kill/corrupt injection
             # sites land at deterministic iterations
@@ -2019,6 +2100,10 @@ class Solver:
         FAULTS.corrupt_file("snapshot_corrupt", model_path)
         self._last_snapshot = (it, state_path)
         self._journal_run_state("snapshot")
+        if jax.process_count() > 1:
+            # ISSUE 11: fold the per-host quarantine journals into the
+            # classic audit file at the same snapshot cadence
+            resilience.merge_quarantine_journals(prefix)
         keep = int(getattr(self.sp, "snapshot_keep", 0) or 0)
         if keep > 0:
             # assume_verified: this writer checksummed `manifest`'s files
@@ -2067,19 +2152,60 @@ class Solver:
     def snapshot_native(self, path: str | None = None) -> str:
         """Sharded checkpoint of the FULL training state (params +
         optimizer slots + BN state + iter). No host gather: each shard
-        streams from its device. Returns the checkpoint directory."""
+        streams from its device. Returns the checkpoint directory.
+
+        Verified-atomic since ISSUE 11: after the (collective) orbax
+        save, every host syncs at a write barrier, then rank 0 ALONE
+        publishes the per-shard crc32c manifest — the commit record, so
+        "manifest exists" == "every host's shards landed" — advances
+        the run journal's resume pointer, merges per-host quarantine
+        journals, and runs `snapshot_keep` GC (which sweeps whole
+        .orbax dirs, never the newest verified set)."""
         import orbax.checkpoint as ocp
         prefix = self.sp.snapshot_prefix or "snapshot"
-        path = path or f"{prefix}_iter_{self.iter}.orbax"
+        it = self.iter
+        path = path or f"{prefix}_iter_{it}.orbax"
         path = os.path.abspath(path)
+        with self._guard("snapshot settle"):
+            # same aliasing hazard as the flat path: the save must not
+            # read buffers a still-in-flight step is about to donate
+            jax.block_until_ready((self.params, self.net_state,
+                                   self.opt_state))
         with ocp.StandardCheckpointer() as ckptr:
             ckptr.save(path, {
                 "params": self.params,
                 "opt_state": self.opt_state,
                 "net_state": self.net_state,
-                "iter": jnp.asarray(self.iter, jnp.int32),
+                "iter": jnp.asarray(it, jnp.int32),
             }, force=True)
-        log.info("Native sharded snapshot to %s", path)
+        if jax.process_count() > 1:
+            # all-hosts write barrier BEFORE the commit record: a
+            # manifest covering shards a slow host has not flushed yet
+            # would verify against a torn set
+            from ..parallel.mesh import cluster_barrier
+            if not cluster_barrier(f"caffe_snapshot_{it}"):
+                raise resilience.ClusterError(
+                    f"sharded-snapshot write barrier failed at "
+                    f"iteration {it} (peer host lost mid-checkpoint?)")
+        if self.rank != 0:
+            return path
+        manifest = resilience.write_sharded_manifest(path, it)
+        if FAULTS.active("snapshot_shard_corrupt"):
+            # test-only: post-manifest bitrot in ONE shard — restore
+            # must reject the whole set and fall back
+            shards = resilience.sharded_snapshot_files(path)
+            if shards:
+                FAULTS.corrupt_file("snapshot_shard_corrupt", shards[0])
+        self._last_snapshot = (it, path)
+        self._journal_run_state("snapshot")
+        if jax.process_count() > 1:
+            resilience.merge_quarantine_journals(prefix)
+        keep = int(getattr(self.sp, "snapshot_keep", 0) or 0)
+        if keep > 0:
+            resilience.gc_snapshots(prefix, keep,
+                                    assume_verified=manifest)
+        log.info("Native sharded snapshot to %s (manifest %s)", path,
+                 os.path.basename(manifest))
         return path
 
     def restore_native(self, path: str) -> None:
@@ -2117,6 +2243,10 @@ class Solver:
         self.opt_state = state["opt_state"]
         self.net_state = state["net_state"]
         self.iter = int(state["iter"])
+        # same post-restore contract as restore(): clean guard counters
+        # — a rewind exists to escape the divergence, not re-trip on it
+        self._gstate = None
+        self._guard_prev = None
         log.info("Restored native snapshot from %s (iter %d)", path,
                  self.iter)
 
@@ -2154,11 +2284,14 @@ class Solver:
             return doc["state"]
         # legacy snapshots with no manifest sidecar: newest iteration
         # first, skipping states a (failed) manifest already covers —
-        # re-trying those unverified would resurrect known-bad bytes
+        # re-trying those unverified would resurrect known-bad bytes.
+        # Pre-ISSUE-11 .orbax dirs (written before the sharded-manifest
+        # scheme) are candidates the same way.
         import re
         d = os.path.dirname(prefix) or "."
         stem = os.path.basename(prefix) + "_iter_"
-        pat = re.compile(re.escape(stem) + r"(\d+)\.solverstate(\.h5)?$")
+        pat = re.compile(re.escape(stem)
+                         + r"(\d+)(\.solverstate(\.h5)?|\.orbax)$")
         cands = []
         try:
             for name in os.listdir(d):
@@ -2196,9 +2329,9 @@ class Solver:
         are loaded; corruption raises SnapshotCorruptError (use
         restore_auto for the fall-back-to-older behavior). Manifest-less
         snapshots load unverified, as before."""
-        if path.rstrip("/").endswith(".orbax"):
-            return self.restore_native(path)
         if verify:
+            # .orbax dirs share the manifest scheme since ISSUE 11
+            # (per-shard crc entries) — verify them the same way
             mpath = resilience.manifest_for_state(path)
             if mpath is not None and os.path.exists(mpath):
                 if resilience.verify_snapshot(mpath) is None:
@@ -2206,6 +2339,8 @@ class Solver:
                         f"snapshot {path} failed crc32c verification "
                         f"against {mpath}; resume with --resume auto to "
                         "fall back to the newest prior verified snapshot")
+        if path.rstrip("/").endswith(".orbax"):
+            return self.restore_native(path)
         from .. import io as caffe_io
         if path.endswith(".npz"):  # this framework's pre-interop format
             data = np.load(path)

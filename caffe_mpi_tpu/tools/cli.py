@@ -177,6 +177,36 @@ def _parser() -> argparse.ArgumentParser:
                    "ones after each write — never the newest verified "
                    "one (overrides solver snapshot_keep; 0 = prototxt "
                    "value, which defaults to keep-everything)")
+    # elastic multi-host flags (ISSUE 11, docs/robustness.md
+    # "Multi-host elasticity")
+    p.add_argument("-hosts", "--hosts", type=int, default=0,
+                   help="train: number of host processes in the "
+                   "cluster (the reference's mpirun -n). > 1 "
+                   "initializes jax.distributed against -coordinator "
+                   "(bounded retry/backoff; a missing coordinator "
+                   "journals and exits 87, never hangs), spans the "
+                   "device mesh across every host, and stripes Feeder "
+                   "records per host (overrides solver hosts; 0 = "
+                   "prototxt value, default single-process). Env "
+                   "fallbacks: CAFFE_TPU_NUM_HOSTS / "
+                   "CAFFE_TPU_COORDINATOR / CAFFE_TPU_HOST_ID")
+    p.add_argument("-coordinator", "--coordinator", default="",
+                   help="train: host:port of host 0's coordination "
+                   "service (required with -hosts > 1; overrides "
+                   "solver coordinator)")
+    p.add_argument("-host_id", "--host-id", dest="host_id", type=int,
+                   default=-1,
+                   help="train: this process's host index in "
+                   "[0, hosts) (-1 = CAFFE_TPU_HOST_ID env)")
+    p.add_argument("-host_deadline", "--host-deadline",
+                   dest="host_deadline", type=float, default=0.0,
+                   help="train: cross-host heartbeat deadline in "
+                   "seconds — a peer host silent this long is "
+                   "journaled to <prefix>.run.json and this worker "
+                   "exits 87 (EXIT_CLUSTER) for the supervisor's "
+                   "coordinated restart, instead of hanging inside "
+                   "the next collective (overrides solver "
+                   "host_deadline; 0 = prototxt value, default off)")
     # self-healing flags (ISSUE 4, docs/robustness.md)
     p.add_argument("-train_guard", "--train-guard", dest="train_guard",
                    action="store_true",
@@ -378,6 +408,23 @@ def _supervised_train(args) -> int:
         anomaly_lr_mult=sp.anomaly_lr_mult)
 
 
+def _cluster_exit(prefix: str, rank: int, reason: str, error: str) -> int:
+    """Journal a bounded cluster failure (ISSUE 11) and hand exit 87 to
+    the supervisor. Rank 0 owns `<prefix>.run.json`; other ranks write
+    their own `.r<k>` journal (same convention as the solver)."""
+    from ..utils import resilience
+    log.error("%s: %s; exiting %d for the supervisor's coordinated "
+              "restart", reason, error, resilience.EXIT_CLUSTER)
+    try:
+        resilience.write_run_manifest(
+            prefix if rank <= 0 else f"{prefix}.r{rank}",
+            reason=reason, error=error,
+            exit_code=resilience.EXIT_CLUSTER)
+    except OSError:
+        log.exception("cluster-failure journal failed (continuing)")
+    return resilience.EXIT_CLUSTER
+
+
 def cmd_train(args) -> int:
     from ..proto import SolverParameter
     from ..solver import Solver
@@ -450,6 +497,29 @@ def cmd_train(args) -> int:
         sp.base_lr = sp.base_lr * args.lr_scale
         log.info("base_lr scaled by %g -> %g (anomaly rewind)",
                  args.lr_scale, sp.base_lr)
+    if args.hosts:
+        sp.hosts = args.hosts
+    if args.coordinator:
+        sp.coordinator = args.coordinator
+    if args.host_deadline:
+        sp.host_deadline = args.host_deadline
+
+    # elastic multi-host bootstrap (ISSUE 11): form the jax.distributed
+    # cluster BEFORE any jax device use, so the mesh below spans every
+    # host. Cluster-formation failure is a bounded, journaled exit 87 —
+    # the supervisor's coordinated restart re-forms the cluster.
+    from ..parallel import mesh as mesh_mod
+    journal_prefix = args.snapshot_prefix or sp.snapshot_prefix \
+        or "snapshot"
+    world, host_rank = 1, 0
+    try:
+        world, coordinator, host_rank = mesh_mod.resolve_cluster(
+            sp, host_id=args.host_id)
+        if world > 1:
+            mesh_mod.init_distributed(coordinator, world, host_rank)
+    except resilience.ClusterError as e:
+        return _cluster_exit(journal_prefix, max(host_rank, 0),
+                             "cluster_init_failed", str(e))
     model_dir = os.path.dirname(os.path.abspath(args.solver)) \
         if not (sp.net and os.path.exists(sp.net)) else ""
     gpipe_cfg = None
@@ -460,8 +530,13 @@ def cmd_train(args) -> int:
             raise SystemExit("-gpipe is exclusive of -gpu/-mesh "
                              "(stages own whole devices)")
         gpipe_cfg = {"stages": args.gpipe, "micro": args.gpipe_micro}
+    cluster_rank = 0
+    if world > 1:
+        import jax as _jax
+        cluster_rank = _jax.process_index()
     solver = Solver(sp, mesh=_select_mesh(args.gpu, args.mesh),
                     model_dir=model_dir, gpipe=gpipe_cfg,
+                    rank=cluster_rank,
                     data_shape_probe=lambda lp: data_shape_probe(lp, model_dir))
     if args.resume and args.resume != "auto":
         # a concrete path behaves like -snapshot
@@ -471,12 +546,64 @@ def cmd_train(args) -> int:
         # newest verified snapshot (crc32c manifest scan); falls back
         # across corrupt snapshots; None = fresh start. The explicit
         # -snapshot/-weights flags only apply when auto found nothing.
-        resumed = solver.restore_auto()
+        # Cluster runs must agree on ONE resume point (divergent picks
+        # would deadlock the first collective): rank 0 scans and
+        # publishes its decision on the coordination service; peers
+        # restore exactly that snapshot.
+        if world > 1 and cluster_rank > 0:
+            # rank 0 crc-verifies (and may fall back across) whole
+            # checkpoints before publishing — the wait must scale with
+            # checkpoint size, not a fixed constant (env-tunable for
+            # huge sharded sets); a dead service still returns fast
+            peer = mesh_mod.cluster_kv_get(
+                "caffe/resume_state",
+                timeout_s=float(os.environ.get(
+                    "CAFFE_TPU_RESUME_TIMEOUT", "600") or 600))
+            if peer is None:
+                return _cluster_exit(
+                    journal_prefix, cluster_rank, "cluster_resume_failed",
+                    "rank 0 never published its resume decision")
+            if peer:
+                try:
+                    solver.restore(peer)
+                except (resilience.SnapshotCorruptError, OSError) as e:
+                    # shards not yet visible on this host (NFS lag) or
+                    # local bitrot: a journaled 87 lets the supervisor
+                    # retry the coordinated resume instead of an
+                    # unjournaled crash with a generic exit code
+                    return _cluster_exit(
+                        journal_prefix, cluster_rank,
+                        "cluster_resume_failed",
+                        f"rank 0's snapshot {peer} failed to load "
+                        f"here: {e}")
+                resumed = peer
+        else:
+            resumed = solver.restore_auto()
+            if world > 1 and not mesh_mod.cluster_kv_set(
+                    "caffe/resume_state", resumed or ""):
+                # peers are blocked waiting for this key; training on
+                # alone would end in an unbounded first-collective hang
+                # after they give up — the exact hang class ISSUE 11
+                # exists to bound
+                return _cluster_exit(
+                    journal_prefix, cluster_rank, "cluster_resume_failed",
+                    "could not publish the resume decision (dead "
+                    "coordination service?)")
     if resumed is None:
         if args.snapshot:
             try:
                 solver.restore(args.snapshot)
             except resilience.SnapshotCorruptError as e:
+                if world > 1:
+                    # a PER-HOST fallback scan could land ranks on
+                    # divergent iterations and deadlock the first
+                    # collective — journal + 87 so the supervisor
+                    # retries the coordinated resume instead
+                    return _cluster_exit(
+                        journal_prefix, cluster_rank,
+                        "cluster_resume_failed",
+                        f"-snapshot {args.snapshot} corrupt on this "
+                        f"host: {e}")
                 log.warning("%s", e)
                 resumed = solver.restore_auto()
                 if resumed is None:
@@ -527,7 +654,15 @@ def cmd_train(args) -> int:
     if solver.test_nets:
         tf = []
         for tnet in solver.test_nets:
-            f = _build_feeders(tnet, "TEST", solver_param=sp)
+            # TEST feeders stripe per host exactly like TRAIN: the
+            # eval path assembles each host's batch as a process-local
+            # SHARD of the global test batch (shard_feeds), so
+            # unstriped feeders would evaluate duplicate copies of
+            # stripe 0 and never see the other hosts' records
+            f = _build_feeders(tnet, "TEST",
+                               rank=_jax.process_index(),
+                               world=_jax.process_count(),
+                               solver_param=sp)
             if f is None:
                 feeds_t = _synthetic_feed(tnet, seed=1)
                 tf.append(lambda it, feeds_t=feeds_t: feeds_t)
@@ -538,9 +673,11 @@ def cmd_train(args) -> int:
     # bind the quarantine journal next to the snapshots: corrupt
     # records the feeder substitutes during this run are audited in
     # <prefix>.quarantine.json (ISSUE 4; appends across supervised
-    # restarts)
-    resilience.QUARANTINE.configure(
-        (sp.snapshot_prefix or "snapshot") + ".quarantine.json")
+    # restarts). Multi-host runs journal per host (.r<k>, ISSUE 11);
+    # rank 0 merges them at snapshot time.
+    resilience.QUARANTINE.configure(resilience.quarantine_journal_path(
+        sp.snapshot_prefix or "snapshot", rank=cluster_rank,
+        world=world))
 
     t0 = time.time()
     start_iter = solver.iter
@@ -563,6 +700,27 @@ def cmd_train(args) -> int:
                 and solver.should_snapshot_after_train()):
             solver.snapshot()  # reference snapshots at stop/after-train
             # (solver.cpp:402-407)
+        if world > 1:
+            # end-of-training barrier (ISSUE 11): hosts finish at
+            # skewed times; rank 0's coordination service must not die
+            # underneath a peer still mid-collective/KV-call. The
+            # heartbeat keeps ticking while we wait here, so a peer
+            # that CRASHED instead of arriving still becomes a bounded
+            # exit-87 within host_deadline.
+            if not mesh_mod.cluster_barrier("caffe_train_done"):
+                return _cluster_exit(
+                    journal_prefix, cluster_rank, "cluster_exit_failed",
+                    "end-of-training barrier timed out (peer host "
+                    "lost after training?)")
+            # only NOW is departure clean — a farewell on a failure
+            # path would stop peers monitoring a crashed host
+            solver.heartbeat_farewell()
+    except resilience.ClusterError as e:
+        # a cluster operation inside training (sharded-snapshot write
+        # barrier) failed in a bounded way — journal + 87, supervisor
+        # restarts the whole cluster
+        return _cluster_exit(journal_prefix, cluster_rank,
+                             "cluster_lost", str(e))
     except resilience.NumericAnomalyError as e:
         # the solver already journaled the anomaly to <prefix>.run.json;
         # exit 88 routes the supervisor through anomaly_action
@@ -578,6 +736,9 @@ def cmd_train(args) -> int:
         # drain any debounced quarantine-journal tail: the audit must
         # be complete on every exit path
         resilience.QUARANTINE.flush()
+    if world > 1:
+        # past the exit barrier on every host: safe to drop the service
+        mesh_mod.shutdown_distributed()
     elapsed = time.time() - t0
     imgs = (solver.iter - start_iter) * solver._batch_images() \
         * max(sp.iter_size, 1) * max(solver._gpipe_micro, 1)

@@ -56,6 +56,9 @@ KNOBS = (
     "loss_scale_window",  # ISSUE 9: clean steps before scale regrowth
     "serve_dtype",      # ISSUE 9: bf16 serving bucket programs
     "decoded_cache_mb",  # ISSUE 10: bounded decoded-record cache tier
+    "hosts",            # ISSUE 11: elastic multi-host cluster size
+    "coordinator",      # ISSUE 11: coordination-service address
+    "host_deadline",    # ISSUE 11: cross-host heartbeat deadline
 )
 
 CONFIG_FILE = os.path.join("caffe_mpi_tpu", "proto", "config.py")

@@ -54,6 +54,22 @@ log = logging.getLogger("caffe_mpi_tpu.resilience")
 EXIT_WATCHDOG = 86
 EXIT_FAULT = 87
 EXIT_NUMERIC = 88
+# ISSUE 11: cluster losses (a dead peer host, a severed DCN link, a
+# coordinator that never answers) share code 87 with injected faults —
+# both are environmental failures the supervisor restarts from (not
+# rewinds like 88, not tunnel hangs like 86); the run journal's
+# `reason` field carries the specific cluster event.
+EXIT_CLUSTER = EXIT_FAULT
+
+
+class ClusterError(RuntimeError):
+    """Multi-host cluster formation or liveness failed in a BOUNDED way:
+    `init_distributed` exhausted its retry budget against a missing
+    coordinator, or a cluster barrier / KV exchange timed out. The CLI
+    journals the event to `<prefix>.run.json` and converts this to exit
+    code EXIT_CLUSTER (87) so the supervisor restarts the local worker
+    instead of the process hanging inside an uninterruptible
+    collective."""
 
 
 class NumericAnomalyError(RuntimeError):
@@ -124,6 +140,12 @@ FAULT_SITES = {
                       "after fetch (bitrot the crc check must catch)",
     "record_decode": "truncate record values [arg, arg+count) so the "
                      "Datum parse fails",
+    "host_loss": "kill the local worker at a heartbeat boundary "
+                 "(beat seq >= arg) — a peer host dying mid-run",
+    "coordinator_down": "fail distributed init for the first `count` "
+                        "attempts (missing/unreachable coordinator)",
+    "snapshot_shard_corrupt": "flip a byte in one orbax shard "
+                              "post-manifest (sharded-snapshot bitrot)",
 }
 
 class FaultPlane:
@@ -430,8 +452,15 @@ class SnapshotCorruptError(RuntimeError):
 
 
 def manifest_for_state(state_path: str) -> str | None:
-    """Sidecar manifest path for a .solverstate[.h5]; None for formats
-    without a manifest scheme (.npz pre-interop, .orbax native)."""
+    """Sidecar manifest path for a .solverstate[.h5] or a sharded
+    .orbax checkpoint directory (ISSUE 11); None for formats without a
+    manifest scheme (.npz pre-interop). The orbax manifest KEEPS the
+    .orbax infix (`s_iter_N.orbax.manifest.json`) — stripping it would
+    collide with a flat snapshot's manifest at the same iteration
+    under the same prefix and silently orphan one of the two sets."""
+    state_path = state_path.rstrip("/")
+    if state_path.endswith(".orbax"):
+        return state_path + _MANIFEST_SUFFIX
     for suf in _STATE_SUFFIXES:
         if state_path.endswith(suf):
             return state_path[: -len(suf)] + _MANIFEST_SUFFIX
@@ -462,17 +491,81 @@ def write_snapshot_manifest(state_path: str, it: int,
     return mpath
 
 
+def sharded_snapshot_files(orbax_dir: str) -> list[str]:
+    """Every regular file under a sharded (.orbax) checkpoint dir,
+    sorted by descending size then path — index 0 is the natural
+    victim for the `snapshot_shard_corrupt` injection site (the
+    biggest file is a tensorstore data shard, not metadata)."""
+    out = []
+    for root, _dirs, names in os.walk(orbax_dir):
+        for name in names:
+            out.append(os.path.join(root, name))
+    out.sort(key=lambda p: (-os.path.getsize(p), p))
+    return out
+
+
+def write_sharded_manifest(orbax_dir: str, it: int) -> str:
+    """Commit record for a sharded (.orbax) snapshot (ISSUE 11): one
+    crc32c + size entry PER SHARD FILE under the checkpoint directory,
+    written LAST (after the collective orbax save, after the all-hosts
+    write barrier, by rank 0 alone) — so "manifest exists" == "every
+    host's shards landed". Entries are paths relative to the dir, so
+    verify re-walks exactly the recorded shard set and a torn or
+    bit-rotted shard set fails as a unit."""
+    orbax_dir = os.path.abspath(orbax_dir.rstrip("/"))
+    mpath = manifest_for_state(orbax_dir)
+    if mpath is None:
+        raise ValueError(f"no manifest scheme for {orbax_dir!r}")
+    entries = {}
+    for path in sharded_snapshot_files(orbax_dir):
+        rel = os.path.relpath(path, orbax_dir)
+        entries[rel] = {
+            "file": rel,
+            "size": os.path.getsize(path),
+            "crc32c": f"{crc32c_file(path):08x}",
+        }
+    if not entries:
+        raise ValueError(f"sharded snapshot {orbax_dir!r} is empty")
+    doc = {"schema": _MANIFEST_SCHEMA, "kind": "orbax",
+           "iteration": int(it), "time": time.time(),
+           "dir": os.path.basename(orbax_dir), "files": entries}
+    with atomic_output(mpath) as tmp:
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+    return mpath
+
+
 def verify_snapshot(manifest_path: str) -> dict | None:
     """Re-check every file the manifest covers against its recorded size
     and crc32c. Returns the manifest dict (with a resolved 'state' path)
     on success, None on any mismatch / missing file / unreadable
-    manifest — callers treat None as 'fall back to an older snapshot'."""
+    manifest — callers treat None as 'fall back to an older snapshot'.
+    Sharded manifests (kind 'orbax', ISSUE 11) verify every recorded
+    shard file relative to the checkpoint dir; 'state' resolves to the
+    dir itself."""
     try:
         with open(manifest_path) as f:
             doc = json.load(f)
     except (OSError, ValueError):
         return None
     base = os.path.dirname(os.path.abspath(manifest_path))
+    if doc.get("kind") == "orbax":
+        root = os.path.join(base, doc.get("dir") or "")
+        if not doc.get("dir") or not os.path.isdir(root) \
+                or not doc.get("files"):
+            return None
+        for ent in doc["files"].values():
+            path = os.path.join(root, ent["file"])
+            try:
+                if os.path.getsize(path) != ent["size"]:
+                    return None
+                if f"{crc32c_file(path):08x}" != ent["crc32c"]:
+                    return None
+            except OSError:
+                return None
+        doc["state"] = root
+        doc["manifest"] = os.path.abspath(manifest_path)
+        return doc
     state_path = None
     for role, ent in doc.get("files", {}).items():
         path = os.path.join(base, ent["file"])
@@ -493,8 +586,9 @@ def verify_snapshot(manifest_path: str) -> dict | None:
 
 
 def iter_snapshot_manifests(prefix: str) -> list[tuple[int, str]]:
-    """All `<prefix>_iter_<N>.manifest.json` sidecars, newest iteration
-    first. Pure directory listing — no file reads, no verification."""
+    """All `<prefix>_iter_<N>[.orbax].manifest.json` sidecars, newest
+    iteration first. Pure directory listing — no file reads, no
+    verification."""
     d = os.path.dirname(prefix) or "."
     stem = os.path.basename(prefix) + "_iter_"
     out = []
@@ -506,6 +600,8 @@ def iter_snapshot_manifests(prefix: str) -> list[tuple[int, str]]:
         if not (name.startswith(stem) and name.endswith(_MANIFEST_SUFFIX)):
             continue
         mid = name[len(stem):-len(_MANIFEST_SUFFIX)]
+        if mid.endswith(".orbax"):  # sharded sets (ISSUE 11)
+            mid = mid[: -len(".orbax")]
         if mid.isdigit():
             out.append((int(mid), os.path.join(d, name)))
     out.sort(key=lambda p: p[0], reverse=True)
@@ -555,13 +651,29 @@ def gc_snapshots(prefix: str, keep: int,
     for _it, mpath in manifests[keep:]:
         if mpath == newest_verified:
             continue
+        victims, dirs = [], []
         try:
             with open(mpath) as f:
                 doc = json.load(f)
-            victims = [os.path.join(base, ent["file"])
-                       for ent in doc.get("files", {}).values()]
+            if doc.get("kind") == "orbax":
+                # sharded snapshot (ISSUE 11): the whole checkpoint
+                # DIRECTORY is the file set — per-entry unlinks would
+                # leave a half-deleted dir that still looks like a
+                # checkpoint to a directory listing
+                if doc.get("dir"):
+                    dirs = [os.path.join(base, doc["dir"])]
+            else:
+                victims = [os.path.join(base, ent["file"])
+                           for ent in doc.get("files", {}).values()]
         except (OSError, ValueError):
             victims = []
+        for d in dirs:  # dir first: a crash here leaves the manifest,
+            import shutil  # whose verify then fails (never a dir that
+            try:           # a later legacy scan could resurrect)
+                shutil.rmtree(d)
+                removed.append(d)
+            except OSError:
+                pass
         for path in victims + [mpath]:
             try:
                 os.unlink(path)
@@ -749,6 +861,54 @@ class QuarantineLog:
 QUARANTINE = QuarantineLog()
 
 
+def quarantine_journal_path(prefix: str, rank: int = 0,
+                            world: int = 1) -> str:
+    """Journal file for one host's quarantine decisions. Single-host
+    keeps the classic `<prefix>.quarantine.json`; in a multi-host run
+    (ISSUE 11) every host journals its OWN stripe's quarantines to
+    `<prefix>.quarantine.r<k>.json` (concurrent atomic rewrites of one
+    shared file from N hosts would drop entries), and rank 0 merges the
+    per-host journals into the classic path at snapshot time."""
+    if world <= 1:
+        return prefix + ".quarantine.json"
+    return prefix + f".quarantine.r{int(rank)}.json"
+
+
+def merge_quarantine_journals(prefix: str) -> int:
+    """Merge every per-host quarantine journal
+    (`<prefix>.quarantine.r*.json`) into the classic
+    `<prefix>.quarantine.json`, deduped by (source, index) and sorted
+    for a stable audit. Called by rank 0 at snapshot time (the same
+    cadence the single-host journal flushes at). Returns the merged
+    record count; 0 with no per-host journals (single-host runs never
+    pay this)."""
+    import glob as _glob
+    d = os.path.dirname(prefix) or "."
+    stem = os.path.basename(prefix) + ".quarantine.r"
+    parts = sorted(p for p in _glob.glob(
+        os.path.join(glob_escape(d), glob_escape(stem) + "*.json")))
+    if not parts:
+        return 0
+    merged: dict[tuple, dict] = {}
+    for path in parts:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        for ent in doc.get("records", []):
+            merged.setdefault((ent.get("source"), ent.get("index")), ent)
+    records = sorted(merged.values(),
+                     key=lambda e: (e.get("source") or "",
+                                    e.get("index") or 0))
+    out = {"schema": _MANIFEST_SCHEMA, "records": records,
+           "merged_from": [os.path.basename(p) for p in parts]}
+    with atomic_output(prefix + ".quarantine.json") as tmp:
+        with open(tmp, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+    return len(records)
+
+
 # ---------------------------------------------------------------------------
 # Dispatch watchdog
 # ---------------------------------------------------------------------------
@@ -768,12 +928,23 @@ class DispatchWatchdog:
     `hard_exit=False` (tests) records the trip in `.tripped` and fires
     `.tripped_event` instead of exiting. The deadline must exceed the
     worst jit-compile a dispatch can trigger — compiles happen inside
-    dispatch sections and are legitimate multi-second stalls."""
+    dispatch sections and are legitimate multi-second stalls.
+
+    `pulse` (ISSUE 11): an optional callable invoked once per poll tick
+    from the monitor thread — the cross-host heartbeat
+    (`HostHeartbeat.tick`) rides here, so one thread owns both liveness
+    checks (a dead peer mid-collective and a dead tunnel mid-dispatch
+    are the same shape of failure: an uninterruptible C++ wait only a
+    Python side-thread can bound). Pulse exceptions are logged, never
+    fatal to the monitor; a deadline of `inf` is allowed for
+    heartbeat-only arming (sections then never trip)."""
 
     def __init__(self, deadline: float, on_timeout=None, *,
-                 poll: float | None = None, hard_exit: bool = True):
+                 poll: float | None = None, hard_exit: bool = True,
+                 pulse=None):
         self.deadline = float(deadline)
         self.on_timeout = on_timeout
+        self.pulse = pulse
         self.poll = poll if poll is not None else min(
             max(self.deadline / 4.0, 0.05), 5.0)
         self.hard_exit = hard_exit
@@ -805,6 +976,12 @@ class DispatchWatchdog:
 
     def _run(self) -> None:
         while not self._stop.wait(self.poll):
+            if self.pulse is not None:
+                try:
+                    self.pulse()
+                except Exception:
+                    log.exception("watchdog: pulse callback failed "
+                                  "(continuing)")
             now = time.monotonic()
             with self._lock:
                 oldest = min(self._open.values(), key=lambda lt: lt[1],
@@ -833,6 +1010,215 @@ class DispatchWatchdog:
 
 
 _NULL_SECTION = nullcontext()
+
+
+# ---------------------------------------------------------------------------
+# Cross-host heartbeat (ISSUE 11) — host-loss detection
+# ---------------------------------------------------------------------------
+
+class DirBeatTransport:
+    """Heartbeat transport over a shared directory (`CAFFE_TPU_HB_DIR`):
+    one atomically-rewritten sequence file per host. The default
+    transport is the jax.distributed key-value store
+    (parallel/mesh.py:KVBeatTransport); this one exists for unit tests
+    and as an operator escape hatch when checkpoint storage is shared
+    but the coordination service is suspect. NFS-grade semantics
+    suffice: readers only compare monotone sequence numbers.
+
+    The directory OUTLIVES process incarnations (the KV store does
+    not — the coordination service is recreated per cluster epoch), so
+    every record is stamped with a per-process incarnation token:
+    readers fold a token change into a monotone surrogate sequence
+    (a restarted publisher's seq-0 still reads as an ADVANCE, never as
+    staleness), and a farewell marker only counts for the incarnation
+    whose beats are currently being read — a bye left by an earlier
+    clean run cannot disable mourning of the next incarnation."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self._nonce = f"{os.getpid()}.{int(time.time() * 1e6)}"
+        self._token: dict[int, str] = {}  # per-peer current incarnation
+        self._base: dict[int, int] = {}   # surrogate offset per token
+        self._hi: dict[int, int] = {}     # highest surrogate returned
+
+    def _beat_file(self, host: int) -> str:
+        return os.path.join(self.path, f"hb_{int(host)}")
+
+    def publish(self, host: int, seq: int) -> None:
+        with atomic_output(self._beat_file(host)) as tmp:
+            with open(tmp, "w") as f:
+                f.write(f"{self._nonce}:{int(seq)}")
+
+    def _read(self, host: int) -> tuple[str, int] | None:
+        try:
+            with open(self._beat_file(host)) as f:
+                token, _, seq = f.read().strip().rpartition(":")
+            return (token, int(seq)) if token else None
+        except (OSError, ValueError):
+            return None
+
+    def latest_seq(self, host: int) -> int:
+        """Newest beat `host` has published as a surrogate sequence
+        monotone ACROSS incarnations, -1 when none. Non-blocking (the
+        tick cadence is the retry loop). The latest-not-exact contract
+        matters: a reader that arms late or stalls must catch up from
+        whatever state exists, never wedge on an overwritten beat."""
+        rec = self._read(host)
+        if rec is None:
+            return -1
+        token, seq = rec
+        if self._token.get(host) != token:
+            # a new incarnation restarts at seq 0: offset it past
+            # everything the previous one published
+            self._base[host] = self._hi.get(host, -1) + 1
+            self._token[host] = token
+        val = self._base.get(host, 0) + seq
+        self._hi[host] = max(self._hi.get(host, -1), val)
+        return val
+
+    def farewell(self, host: int) -> None:
+        with atomic_output(os.path.join(self.path,
+                                        f"bye_{int(host)}")) as tmp:
+            with open(tmp, "w") as f:
+                f.write(self._nonce)
+
+    def is_bye(self, host: int) -> bool:
+        try:
+            with open(os.path.join(self.path, f"bye_{int(host)}")) as f:
+                bye_token = f.read().strip()
+        except OSError:
+            return False
+        # only the incarnation whose beats we are reading may say
+        # goodbye; a stale marker (or one for a peer we never heard)
+        # must not suppress mourning
+        return bool(bye_token) and bye_token == self._token.get(host)
+
+
+class HostHeartbeat:
+    """Cross-host liveness detection (ISSUE 11) — the multi-host
+    spelling of the dead-tunnel problem: a peer host that dies (or a
+    severed DCN link) leaves every survivor blocked inside an
+    uninterruptible collective, exactly like a dead tunnel hangs a
+    dispatch (CLAUDE.md). Detection therefore lives on the watchdog's
+    monitor thread (`DispatchWatchdog(pulse=hb.tick)`), not in the
+    train loop.
+
+    Protocol: every `interval` seconds each host publishes a
+    monotonically sequenced beat; each tick also drains peers' beats.
+    A peer silent past `deadline` (measured host-locally — no clock
+    sync: receipt time, not payload time) is a LOST HOST: the journal
+    callback records it to `<prefix>.run.json` and the process
+    hard-exits EXIT_CLUSTER (87) so the supervisor performs the
+    coordinated restart. A peer that published its `farewell` marker
+    (clean end-of-training, ahead of the exit barrier) is excluded
+    instead of mourned. First contact gets `grace` (startup skew:
+    peers arm after their own jit compiles).
+
+    `host_loss` fault site: fires at a beat boundary (seq >= arg),
+    simulating this host dying mid-run for the recovery suite."""
+
+    def __init__(self, transport, host_id: int, n_hosts: int,
+                 deadline: float, *, on_lost=None, interval=None,
+                 grace: float | None = None, hard_exit: bool = True):
+        self.transport = transport
+        self.host = int(host_id)
+        self.peers = [p for p in range(int(n_hosts)) if p != self.host]
+        self.deadline = float(deadline)
+        self.interval = float(interval) if interval else min(
+            max(self.deadline / 4.0, 0.1), 5.0)
+        self.grace = float(grace) if grace is not None else max(
+            3.0 * self.deadline, 30.0)
+        self.hard_exit = hard_exit
+        self.on_lost = on_lost
+        self.lost: tuple[int | str, float] | None = None
+        self.lost_event = threading.Event()
+        now = time.monotonic()
+        self._last_pub = 0.0
+        self._seq = 0
+        self._first = {p: True for p in self.peers}
+        self._last_seen = {p: now for p in self.peers}
+        self._last_seq = {p: -1 for p in self.peers}
+        self._done: set[int] = set()
+        self._pub_warned = False
+
+    def beats_seen(self, peer: int) -> int:
+        """Beats observed from `peer` so far (telemetry/tests)."""
+        return self._last_seq.get(peer, -1) + 1
+
+    def tick(self) -> None:
+        """One liveness round: publish when due, drain peers, mourn the
+        stale. Called from the watchdog monitor thread every poll."""
+        now = time.monotonic()
+        if now - self._last_pub >= self.interval:
+            self._last_pub = now
+            try:
+                self.transport.publish(self.host, self._seq)
+            except Exception as e:
+                if not self._pub_warned:
+                    self._pub_warned = True
+                    log.warning("heartbeat: publish failed (%s); peers "
+                                "will see this host as silent", e)
+            # test-only: die AT a beat boundary — the peer hosts must
+            # detect the silence and exit 87 within their deadline
+            FAULTS.maybe_exit("host_loss", key=self._seq)
+            self._seq += 1
+        for p in self.peers:
+            if p in self._done or self.lost is not None:
+                continue
+            got = False
+            try:
+                # latest-not-exact: any ADVANCE counts as a beat, so a
+                # reader that armed late or stalled catches up from
+                # whatever history the transport still holds — it can
+                # never wedge on a pruned sequence number
+                seq = self.transport.latest_seq(p)
+                if seq > self._last_seq[p]:
+                    self._last_seq[p] = seq
+                    got = True
+            except Exception:
+                pass  # KV errors == silence; the deadline clock decides
+            now = time.monotonic()
+            if got:
+                self._first[p] = False
+                self._last_seen[p] = now
+                continue
+            try:
+                if self.transport.is_bye(p):
+                    log.info("heartbeat: host %d finished cleanly", p)
+                    self._done.add(p)
+                    continue
+            except Exception:
+                pass
+            allowance = self.deadline + (self.grace if self._first[p]
+                                         else 0.0)
+            if now - self._last_seen[p] > allowance:
+                self._trip(p, now - self._last_seen[p])
+
+    def _trip(self, peer: int, elapsed: float) -> None:
+        log.error("heartbeat: host %d silent for %.1fs (deadline %.1fs) "
+                  "— peer lost; journaling and exiting %d for the "
+                  "supervisor's coordinated restart", peer, elapsed,
+                  self.deadline, EXIT_CLUSTER)
+        self.lost = (peer, elapsed)
+        self.lost_event.set()
+        try:
+            if self.on_lost is not None:
+                self.on_lost(peer, elapsed)
+        except Exception:
+            log.exception("heartbeat: host-lost journal failed")
+        if self.hard_exit:
+            logging.shutdown()
+            os._exit(EXIT_CLUSTER)
+
+    def farewell(self) -> None:
+        """Publish the clean-departure marker (call at solver close,
+        after the end-of-training barrier): peers stop expecting beats
+        instead of tripping on post-training shutdown skew."""
+        try:
+            self.transport.farewell(self.host)
+        except Exception:
+            pass  # best-effort: the exit barrier already synchronized
 
 
 # ---------------------------------------------------------------------------
@@ -912,6 +1298,10 @@ def supervise(first_cmd: list[str], resume_cmd: list[str],
         reason = ("deadline" if rc is None else
                   "watchdog" if rc == EXIT_WATCHDOG else
                   "numeric divergence" if rc == EXIT_NUMERIC else
+                  # 87 = injected fault OR cluster loss (ISSUE 11: a
+                  # dead peer / failed distributed init journals the
+                  # specific event to <prefix>.run.json); both restart
+                  "fault/cluster" if rc == EXIT_FAULT else
                   f"exit {rc}")
         with open(failure_log, "a") as f:
             f.write(f"[{time.ctime()}] attempt {attempt + 1}: {reason} "

@@ -1,0 +1,133 @@
+"""Subprocess target for the 2-process cluster WIRING smoke (ISSUE 11).
+
+Run as: python multihost_wiring_worker.py <coordinator> <world> <rank> \
+            <workdir>
+
+This jaxlib's CPU backend cannot form multiprocess computations
+(test_multihost.py), so the wiring facts are asserted WITHOUT placing
+any global array: cluster formation through the hardened
+`init_distributed`, global mesh SHAPE, the Feeder's disjoint per-host
+record striping over a real LMDB (observed indices exchanged through
+the coordination-service KV store — the same channel the heartbeat
+uses), per-host quarantine journals under injected record corruption,
+and rank 0's snapshot-time merge. Rank 0 prints WIRING-OK last; the
+parent (tests/test_multihost.py) asserts it.
+"""
+
+import json
+import os
+import sys
+
+# one process = one simulated single-device host
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_HERE, os.pardir))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from caffe_mpi_tpu.parallel import MeshPlan  # noqa: E402
+from caffe_mpi_tpu.parallel.mesh import (  # noqa: E402
+    KVBeatTransport, cluster_barrier, cluster_kv_get, cluster_kv_set,
+    init_distributed)
+from caffe_mpi_tpu.utils import resilience  # noqa: E402
+
+BATCH, N_RECORDS, N_ITERS = 4, 16, 2
+
+
+def observed_stripe(workdir: str, rank: int, world: int) -> list[int]:
+    """Build N_ITERS batches through the real Feeder and read back
+    WHICH records landed in them (each record's pixels encode its
+    index). The injected `record_corrupt` site (one index inside this
+    rank's stripe, set by the parent) quarantines deterministically on
+    the way — substitute indices are what the stripe then contains."""
+    from caffe_mpi_tpu.data.datasets import LMDBDataset
+    from caffe_mpi_tpu.data.feeder import Feeder
+    ds = LMDBDataset(os.path.join(workdir, "db"))
+    feeder = Feeder(ds, None, BATCH, rank=rank, world=world, threads=1)
+    seen = []
+    try:
+        for it in range(N_ITERS):
+            batch = feeder._build_batch_inner(it)
+            seen.extend(int(v) for v in
+                        np.asarray(batch["data"])[:, 0, 0, 0])
+    finally:
+        feeder.close()
+    return seen
+
+
+def main() -> None:
+    coordinator, world, rank, workdir = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+    init_distributed(coordinator, world, rank, attempts=2, timeout_s=30)
+
+    # -- cluster facts: the mesh spans processes ----------------------
+    assert jax.process_count() == world, jax.process_count()
+    assert jax.process_index() == rank, jax.process_index()
+    assert len(jax.devices()) == world, len(jax.devices())
+    plan = MeshPlan.data_parallel()
+    assert dict(plan.mesh.shape) == {"data": world, "model": 1}, \
+        plan.mesh.shape
+
+    # -- per-host record striping + quarantine journaling -------------
+    prefix = os.path.join(workdir, "run", "s")
+    resilience.QUARANTINE.configure(
+        resilience.quarantine_journal_path(prefix, rank, world))
+    stripe = observed_stripe(workdir, rank, world)
+    # the reference's round-robin striping at host granularity
+    # (data_reader.hpp:28-53): it*B*world + rank*B + slot — except where
+    # the injected corrupt record was substituted by its next healthy
+    # neighbor (a pure function of the record index)
+    corrupt = int(os.environ["WIRING_CORRUPT_INDEX"])
+    expected = []
+    for it in range(N_ITERS):
+        for slot in range(BATCH):
+            flat = (it * BATCH * world + rank * BATCH + slot) % N_RECORDS
+            expected.append(flat + 1 if flat == corrupt else flat)
+    assert stripe == expected, (stripe, expected)
+    assert resilience.QUARANTINE.count() == 1
+    resilience.QUARANTINE.flush()
+
+    # -- KV heartbeat transport works cross-process -------------------
+    import time
+    hb = KVBeatTransport()
+    hb.publish(rank, 0)
+    peer = (rank + 1) % world
+    deadline = time.monotonic() + 15
+    while hb.latest_seq(peer) < 0:
+        assert time.monotonic() < deadline, f"no beat from host {peer}"
+        time.sleep(0.05)
+
+    # exchange observed stripes over the same KV store; rank 0 asserts
+    # global disjointness + exhaustiveness
+    cluster_kv_set(f"wiring/stripe/{rank}", json.dumps(stripe))
+    assert cluster_barrier("wiring_journals", 30.0)
+    if rank == 0:
+        stripes = {r: json.loads(cluster_kv_get(f"wiring/stripe/{r}", 30.0))
+                   for r in range(world)}
+        raw = {r: [(it * BATCH * world + r * BATCH + s) % N_RECORDS
+                   for it in range(N_ITERS) for s in range(BATCH)]
+               for r in range(world)}
+        flat_all = [i for r in sorted(raw) for i in raw[r]]
+        assert len(set(flat_all)) == len(flat_all) == N_RECORDS, \
+            "per-host stripes must be disjoint and exhaustive"
+        assert all(stripes[r] is not None for r in stripes)
+        # rank 0 merges the per-host quarantine journals (what the
+        # solver does at snapshot time) and both hosts' entries land
+        n = resilience.merge_quarantine_journals(prefix)
+        merged = json.load(open(prefix + ".quarantine.json"))
+        indices = sorted(e["index"] for e in merged["records"])
+        both = sorted({int(os.environ["WIRING_CORRUPT_INDEX"]),
+                       int(os.environ["WIRING_PEER_CORRUPT_INDEX"])})
+        assert n == 2 and indices == both, (n, indices, both)
+    assert cluster_barrier("wiring_done", 30.0)
+    jax.distributed.shutdown()
+    print(f"proc {rank}: WIRING-OK")
+
+
+if __name__ == "__main__":
+    main()

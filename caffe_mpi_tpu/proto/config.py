@@ -1132,6 +1132,28 @@ class ServingParameter(Message):
     # ladder is compiled once per model either way — a dtype choice is
     # load-time, so steady-state serving still performs ZERO compiles.
     serve_dtype: str = "f32"
+    # load-shedding admission control (ISSUE 12): bound on the
+    # per-engine request backlog. A submit arriving with this many
+    # requests already pending fails FAST with a typed ShedError
+    # (HTTP 429) instead of growing an unbounded queue whose every
+    # entry will miss its deadline anyway. 0 (default) = unbounded,
+    # today's behavior.
+    serve_queue_limit: int = 0
+    # per-request deadline in milliseconds (ISSUE 12): a request whose
+    # batch cannot dispatch within this long of its arrival fails with
+    # a typed DeadlineError (HTTP 504) at window close instead of aging
+    # in the queue; the batching window is also clamped to it so a
+    # batch never *waits* past its head request's deadline. 0 (default)
+    # = no deadline, today's behavior (zero per-request cost when off).
+    serve_deadline_ms: float = 0.0
+    # dispatch stall breaker deadline in seconds (ISSUE 12): > 0 arms a
+    # resilience.DispatchWatchdog over the serving dispatch/harvest
+    # device sections — a device call blocked this long (dead tunnel)
+    # fails the in-flight futures with DeadlineError, journals to
+    # `<model>.serve.run.json`, and flips the engine unhealthy so new
+    # requests shed immediately (HTTP 503) instead of hanging; a
+    # recovery probe re-arms it. 0 (default) = breaker off.
+    serve_stall_s: float = 0.0
 
 
 SOLVER_TYPE_NAMES = {

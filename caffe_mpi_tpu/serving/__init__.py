@@ -7,8 +7,13 @@ service). See engine.py for the TPU-native design notes.
 
 from .engine import (BucketedForward, CompileCounter, InferenceModel,
                      ServingEngine, bucket_for, plan_ladder)
+from .errors import (DeadlineError, EngineClosedError, EngineUnhealthyError,
+                     ServingError, ShedError, SwapError)
+from .watch import SnapshotWatcher
 
 __all__ = [
     "BucketedForward", "CompileCounter", "InferenceModel", "ServingEngine",
     "bucket_for", "plan_ladder",
+    "ServingError", "ShedError", "DeadlineError", "EngineClosedError",
+    "EngineUnhealthyError", "SwapError", "SnapshotWatcher",
 ]

@@ -28,10 +28,14 @@ import queue
 import threading
 import time
 from collections import deque
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass, field
 
 import numpy as np
+
+from ..utils.resilience import FAULTS
+from .errors import (DeadlineError, EngineClosedError,
+                     EngineUnhealthyError, ShedError)
 
 log = logging.getLogger(__name__)
 
@@ -70,7 +74,17 @@ class Batcher:
         self._idle = threading.Event()
         self._idle.set()
         self._stop = False
+        self._draining = False
         self._threads: list[threading.Thread] = []
+        # resilience telemetry + in-flight registry (ISSUE 12):
+        # dispatched-but-unresolved groups, so the stall breaker can
+        # fail their futures from the monitor thread while the hung
+        # dispatch/harvest thread is stuck inside C++
+        self.shed_count = 0
+        self.deadline_count = 0
+        self.max_queue_depth = 0
+        self._inflight: dict[int, list[_Request]] = {}
+        self._inflight_next = 0
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> None:
@@ -111,10 +125,11 @@ class Batcher:
                 break
             if item is None:
                 continue
+            self._done_inflight(item[5])
             self._engine.note_retire(item[1])
             for r in item[0]:
                 self._resolve(r.future,
-                              exc=RuntimeError("serving engine closed"))
+                              exc=EngineClosedError("serving engine closed"))
             self._retire(len(item[0]))
         with self._cv:
             while self._pending:
@@ -124,15 +139,70 @@ class Batcher:
             if self._outstanding <= 0:
                 self._idle.set()  # cancelled requests never harvest
 
+    def shutdown(self, timeout: float = 60.0) -> None:
+        """Graceful drain (ISSUE 12): stop accepting new requests, make
+        the dispatcher flush its open window immediately, wait for every
+        accepted request to resolve, then close. Unlike close(), nothing
+        admitted before the drain began is cancelled."""
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()  # wake the window wait to flush now
+        try:
+            self.drain(timeout)
+        except TimeoutError:
+            log.warning("serving: graceful drain timed out after %.0fs; "
+                        "cancelling the stragglers", timeout)
+        self.close()
+
+    def ensure_threads(self) -> None:
+        """Recovery path (ISSUE 12): respawn worker threads that DIED
+        (an exception escaped their loop). Threads that are alive —
+        even wedged inside a hung device call — are left alone: a
+        duplicate dispatcher would double-pop the queue, and a wedged
+        call cannot be reclaimed in-process anyway."""
+        targets = (("serve-dispatch", self._dispatch_loop),
+                   ("serve-harvest", self._harvest_loop))
+        with self._cv:
+            if self._stop or not self._threads:
+                return
+            for i, (name, target) in enumerate(targets):
+                if i < len(self._threads) and self._threads[i].is_alive():
+                    continue
+                t = threading.Thread(target=target, name=name, daemon=True)
+                t.start()
+                self._threads[i] = t
+                log.warning("serving: respawned dead %s thread", name)
+
     # -- submission -----------------------------------------------------
     def submit(self, model: str, data: np.ndarray) -> Future:
         req = _Request(model, data, time.perf_counter())
         with self._cv:
-            if self._stop:
-                raise RuntimeError("serving engine is closed")
+            if self._stop or self._draining:
+                raise EngineClosedError("serving engine is closed")
+            if not self._engine.healthy:
+                # re-check under _cv: engine.submit's lock-free health
+                # check can race the breaker trip, and a request that
+                # lands in _pending AFTER fail_inflight drained it sits
+                # behind a wedged dispatcher forever (fail_inflight
+                # also holds _cv, so this check closes the race)
+                self._engine.note_unhealthy_shed()
+                raise EngineUnhealthyError(
+                    "serving engine unhealthy (dispatch stall breaker "
+                    "open); request shed")
+            limit = self._engine.queue_limit
+            if limit and len(self._pending) >= limit:
+                # load-shedding admission control (ISSUE 12): fail FAST
+                # in the caller's thread — an unbounded backlog just
+                # converts overload into universal deadline misses
+                self.shed_count += 1
+                raise ShedError(
+                    f"serving backlog at serve_queue_limit={limit}; "
+                    "request shed")
             if not self._threads:
                 self.start()
             self._pending.append(req)
+            self.max_queue_depth = max(self.max_queue_depth,
+                                       len(self._pending))
             self._pending_by_model[model] = \
                 self._pending_by_model.get(model, 0) + 1
             self._outstanding += 1
@@ -167,6 +237,29 @@ class Batcher:
                 self._pending_by_model.pop(model, None)
         return group
 
+    def _expire(self, group: list[_Request]) -> list[_Request]:
+        """Deadline check at window close (ISSUE 12): requests that can
+        no longer dispatch within `serve_deadline_ms` of their arrival
+        fail with a typed DeadlineError instead of aging further in a
+        batch whose result they would discard anyway. Zero cost when
+        the knob is off."""
+        dl_ms = self._engine.deadline_ms
+        if not dl_ms:
+            return group
+        now = time.perf_counter()
+        live = []
+        for r in group:
+            aged = (now - r.t_enqueue) * 1e3
+            if aged > dl_ms:
+                self.deadline_count += 1
+                self._resolve(r.future, exc=DeadlineError(
+                    f"request aged {aged:.0f}ms past "
+                    f"serve_deadline_ms={dl_ms:g} before dispatch"))
+                self._retire(1)
+            else:
+                live.append(r)
+        return live
+
     def _dispatch_loop(self) -> None:
         while True:
             with self._cv:
@@ -178,9 +271,18 @@ class Batcher:
                 model = self._engine.model(head.model)
                 max_bucket = model.fwd.ladder[-1]
                 # batching window: measured from the BATCH's first
-                # request; a full max bucket closes the window early
-                deadline = head.t_enqueue + self._engine.window_ms / 1e3
-                while not self._stop:
+                # request; a full max bucket closes the window early.
+                # The window is clamped to HALF of serve_deadline_ms so
+                # a batch closes with dispatch margin in hand instead
+                # of waiting until the exact instant its head request
+                # expires (the deadline knob shrinks latency, never
+                # adds it).
+                window_s = self._engine.window_ms / 1e3
+                if self._engine.deadline_ms:
+                    window_s = min(window_s,
+                                   self._engine.deadline_ms / 2e3)
+                deadline = head.t_enqueue + window_s
+                while not self._stop and not self._draining:
                     remaining = deadline - time.perf_counter()
                     if remaining <= 0 or \
                             self._group_ready(head.model, max_bucket):
@@ -189,24 +291,88 @@ class Batcher:
                 if self._stop:
                     return
                 group = self._take_group(head.model, max_bucket)
+            group = self._expire(group)
             if group:
                 self._dispatch(group)
 
     @staticmethod
     def _resolve(future: Future, value=None, exc: Exception | None = None
-                 ) -> None:
-        """Resolve a request future, tolerating caller-side cancel():
-        a PENDING future always accepts cancel(), so an unconditional
-        set_result would raise InvalidStateError and kill this worker
-        thread for every later request."""
-        if future.set_running_or_notify_cancel():
-            if exc is not None:
-                future.set_exception(exc)
-            else:
-                future.set_result(value)
+                 ) -> bool:
+        """Resolve a request future, tolerating caller-side cancel()
+        AND prior resolution: a PENDING future always accepts cancel(),
+        so an unconditional set_result would raise InvalidStateError
+        and kill this worker thread for every later request — and since
+        ISSUE 12 the stall breaker may have ALREADY failed an in-flight
+        future from the monitor thread when the late harvest finally
+        returns (first resolution wins). Returns True iff this call
+        resolved it."""
+        if future.done() and not future.cancelled():
+            return False  # breaker got there first (skips the CRITICAL
+        try:              # log set_running_... emits before raising)
+            if future.set_running_or_notify_cancel():
+                if exc is not None:
+                    future.set_exception(exc)
+                else:
+                    future.set_result(value)
+                return True
+        except (InvalidStateError, RuntimeError):
+            # already resolved by the breaker (or a racing peer):
+            # set_running_or_notify_cancel raises a bare RuntimeError on
+            # a FINISHED future (CPython), set_result InvalidStateError
+            pass
+        return False
+
+    def fail_inflight(self, exc: Exception) -> int:
+        """Stall-breaker path (ISSUE 12): fail every dispatched-but-
+        unresolved request future with `exc` — AND the whole queued
+        backlog, whose dispatcher is the very thread that is wedged (a
+        parked request behind a dead tunnel would otherwise stay
+        PENDING forever). Called from the watchdog monitor thread.
+        In-flight outstanding counts are NOT retired here — if the
+        wedged call ever returns, the normal harvest path retires them
+        (its own resolves become no-ops); drained backlog entries have
+        no other owner, so they retire here."""
+        with self._cv:
+            groups = [list(g) for g in self._inflight.values()]
+            backlog = list(self._pending)
+            self._pending.clear()
+            self._pending_by_model.clear()
+        failed = 0
+        for group in groups:
+            for r in group:
+                if self._resolve(r.future, exc=exc):
+                    failed += 1
+        for r in backlog:
+            if self._resolve(r.future, exc=exc):
+                failed += 1
+        if backlog:
+            self._retire(len(backlog))
+        return failed
+
+    def _note_inflight(self, group: list[_Request]) -> int:
+        with self._cv:
+            token = self._inflight_next
+            self._inflight_next += 1
+            self._inflight[token] = group
+        return token
+
+    def _done_inflight(self, token: int) -> None:
+        with self._cv:
+            self._inflight.pop(token, None)
 
     def _dispatch(self, group: list[_Request]) -> None:
         name = group[0].model
+        if not self._engine.healthy:
+            # breaker open (ISSUE 12): a live dispatcher (e.g. after a
+            # HARVEST-section trip) must not keep feeding work into a
+            # wedge nobody drains — fail the group typed instead
+            exc = EngineUnhealthyError(
+                "serving engine unhealthy (dispatch stall breaker "
+                "open); request shed")
+            for r in group:
+                self._resolve(r.future, exc=exc)
+            self._retire(len(group))
+            return
         try:
             # re-resolve by name: a load_model() reload during the open
             # batching window must dispatch on the CURRENT model, not a
@@ -230,6 +396,26 @@ class Batcher:
         name = group[0].model
         t0 = time.perf_counter()
         noted = False
+        # register BEFORE the device call: a stall inside it is exactly
+        # when the breaker needs to find these futures
+        token = self._note_inflight(group)
+        if not self._engine.healthy:
+            # authoritative re-check AFTER registration: a trip between
+            # _dispatch's fast-path check and _note_inflight would have
+            # snapshotted _inflight without this group — and the
+            # monitor thread is gone after its one trip, so a group
+            # that slips past here into the device call would hang
+            # with no one left to fail it. Post-registration, either
+            # this read sees the trip (shed here) or fail_inflight's
+            # later snapshot includes the group.
+            self._done_inflight(token)
+            exc = EngineUnhealthyError(
+                "serving engine unhealthy (dispatch stall breaker "
+                "open); request shed")
+            for r in group:
+                self._resolve(r.future, exc=exc)
+            self._retire(len(group))
+            return
         try:
             batch = np.stack([r.data for r in group]).astype(
                 np.float32, copy=False)
@@ -238,12 +424,18 @@ class Batcher:
             # residency check per dispatch: a spilled model re-uploads
             # its weights here (LRU may evict another model's);
             # mark_in_flight pins the model against spilling until the
-            # harvest retires the execution
-            params, state = self._engine._make_resident(
-                model, mark_in_flight=True)
-            noted = True
-            out = model.fwd.run_bucket(params, state, padded)
+            # harvest retires the execution. Both the (possible) weight
+            # upload and the dispatch sit inside one watchdog section —
+            # a dead tunnel hangs either the same way.
+            with self._engine.dispatch_section(f"dispatch:{name}"):
+                # test-only: simulate the dead-tunnel hang (ISSUE 12)
+                FAULTS.maybe_stall("serve_dispatch_stall")
+                params, state = self._engine._make_resident(
+                    model, mark_in_flight=True)
+                noted = True
+                out = model.fwd.run_bucket(params, state, padded)
         except Exception as e:  # noqa: BLE001 — failures go to callers
+            self._done_inflight(token)
             if noted:
                 self._engine.note_retire(model)
             log.exception("serving: dispatch failed for model %r", name)
@@ -256,7 +448,8 @@ class Batcher:
             self.dispatch_count += 1
         # hand the DEVICE array to the harvester; this thread goes
         # straight back to assembling the next batch
-        self._harvest_q.put((group, model, out, t0, time.perf_counter()))
+        self._harvest_q.put((group, model, out, t0, time.perf_counter(),
+                             token))
 
     # -- harvester ------------------------------------------------------
     def _harvest_loop(self) -> None:
@@ -264,18 +457,24 @@ class Batcher:
             item = self._harvest_q.get()
             if item is None:
                 return
-            group, model, out, t_dispatch, t_dispatched = item
+            group, model, out, t_dispatch, t_dispatched, token = item
             try:
                 # the harvest thread exists to pay this device->host
-                # sync off the dispatch path
-                # lint: ok(host-sync) — out-of-band harvest is the design
-                scores = np.asarray(out)
+                # sync off the dispatch path (watchdog-bounded: a dead
+                # tunnel hangs the materialization exactly like a
+                # dispatch)
+                with self._engine.dispatch_section(
+                        f"harvest:{group[0].model}"):
+                    # lint: ok(host-sync) — out-of-band harvest is the design
+                    scores = np.asarray(out)
             except Exception as e:  # noqa: BLE001
+                self._done_inflight(token)
                 self._engine.note_retire(model)
                 for r in group:
                     self._resolve(r.future, exc=e)
                 self._retire(len(group))
                 continue
+            self._done_inflight(token)
             self._engine.note_retire(model)
             t_done = time.perf_counter()
             with self._rec_lock:

@@ -30,14 +30,20 @@ import logging
 import threading
 import time
 from collections import OrderedDict
+from contextlib import nullcontext
 
 import numpy as np
 
 from .. import caffe_io
 from ..net import Net
 from ..proto.config import NetParameter, ServingParameter
+from ..utils.resilience import FAULTS
+from .errors import (DeadlineError, EngineClosedError, EngineUnhealthyError,
+                     SwapError)
 
 log = logging.getLogger(__name__)
+
+_NULL_SECTION = nullcontext()
 
 # default bucket ladder: geometric x4 growth from 1 up to the model's
 # max batch — small arrivals pay a small program, bursts fill max
@@ -111,6 +117,49 @@ def _tree_bytes(tree) -> int:
     return sum(a.size * a.dtype.itemsize
                for a in jax.tree_util.tree_leaves(tree)
                if hasattr(a, "dtype"))
+
+
+def _device_probe(timeout: float) -> bool:
+    """One tiny device round-trip in a side thread, bounded by
+    `timeout`: True iff the device answered in time. The work runs in
+    its own daemon thread because a dead tunnel hangs INSIDE the C++
+    call where no Python signal can interrupt (CLAUDE.md) — the probe
+    thread is then leaked-but-bounded while the caller returns False."""
+    done = threading.Event()
+    ok: list[bool] = []
+
+    def work():
+        try:
+            import jax
+            x = jax.device_put(np.ones((8,), np.float32))
+            # a real round-trip, not just an enqueue
+            # lint: ok(host-sync) — the probe IS the round-trip
+            np.asarray(x + 1.0)
+            ok.append(True)
+        except Exception:  # noqa: BLE001 — any failure = not recovered
+            pass
+        finally:
+            done.set()
+
+    threading.Thread(target=work, daemon=True,
+                     name="serve-device-probe").start()
+    return done.wait(timeout) and bool(ok)
+
+
+def _poison_first_leaf(tree):
+    """Test-only (swap_canary_bad fault site): NaN the first float leaf
+    of a host params tree so the canary gate must reject it."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    for i, leaf in enumerate(leaves):
+        if hasattr(leaf, "dtype") and np.issubdtype(leaf.dtype,
+                                                    np.floating):
+            # lint: ok(host-sync) — host master tree, fault-injection only
+            bad = np.array(leaf, copy=True)
+            bad.flat[0] = np.nan
+            leaves[i] = bad
+            break
+    return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 class BucketedForward:
@@ -384,12 +433,25 @@ class ServingEngine:
     Knobs (ServingParameter, docs/serving.md): `serve_window_ms` —
     batching window; `serve_buckets` — explicit bucket ladder;
     `serve_hbm_mb` — HBM budget for resident weights (0 = unlimited),
-    enforced by LRU spill.
+    enforced by LRU spill; and the resilience trio (ISSUE 12):
+    `serve_queue_limit` — bounded backlog, over-limit submits shed with
+    a typed ShedError; `serve_deadline_ms` — per-request dispatch
+    deadline (DeadlineError at window close instead of aging forever);
+    `serve_stall_s` — dispatch stall breaker (a device call past it
+    fails the in-flight futures, journals, and flips the engine
+    unhealthy so requests shed instead of hanging on a dead tunnel).
+
+    `journal` names a prefix for the serving run journal
+    (`<journal>.serve.run.json` — breaker trips, hot swaps, swap
+    rejections, shutdown); None (library default) journals nothing.
     """
 
     def __init__(self, serving_param: ServingParameter | None = None, *,
                  window_ms: float | None = None, hbm_mb: float | None = None,
-                 buckets=None, start: bool = True):
+                 buckets=None, queue_limit: int | None = None,
+                 deadline_ms: float | None = None,
+                 stall_s: float | None = None, journal: str | None = None,
+                 start: bool = True):
         # AOT warms go through the persistent XLA cache: a restarted
         # server re-loads its zoo from disk hits, not fresh compiles
         from ..utils.compile_cache import enable_compile_cache
@@ -409,6 +471,26 @@ class ServingEngine:
                 f"serve_hbm_mb must be >= 0 (0 = unlimited), "
                 f"got {budget_mb}")
         self.hbm_budget = int(budget_mb * 2**20)  # 0 = unlimited
+        # resilience knobs (ISSUE 12) — all 0 = off = prior behavior
+        self.queue_limit = int(queue_limit if queue_limit is not None
+                               else sp.serve_queue_limit)
+        self.deadline_ms = float(deadline_ms if deadline_ms is not None
+                                 else sp.serve_deadline_ms)
+        self.stall_s = float(stall_s if stall_s is not None
+                             else sp.serve_stall_s)
+        if self.queue_limit < 0:
+            raise ValueError(
+                f"serve_queue_limit must be >= 0 (0 = unbounded), "
+                f"got {self.queue_limit}")
+        if self.deadline_ms < 0:
+            raise ValueError(
+                f"serve_deadline_ms must be >= 0 (0 = no deadline), "
+                f"got {self.deadline_ms}")
+        if self.stall_s < 0:
+            raise ValueError(
+                f"serve_stall_s must be >= 0 (0 = breaker off), "
+                f"got {self.stall_s}")
+        self.journal_prefix = journal
         self.ladder_spec = buckets if buckets is not None \
             else (sp.serve_buckets or None)
         # serve_dtype (ISSUE 9): compute precision for every model's
@@ -432,6 +514,21 @@ class ServingEngine:
         # models whose device upload is in flight (resident for budget
         # math, but not yet spillable)
         self._uploading: set[str] = set()
+        # stall breaker state (ISSUE 12): flipped unhealthy by the
+        # watchdog monitor thread, back healthy by a recovery probe
+        self._healthy = True
+        self._closed = False
+        self._breaker: dict | None = None  # last trip / recovery record
+        self._watchdog = None
+        self._probe_lock = threading.Lock()
+        self._last_probe = 0.0
+        self.stall_trips = 0
+        self.unhealthy_sheds = 0
+        self.swaps = 0
+        self.swap_rejections = 0
+        self.last_activity = time.monotonic()
+        if self.stall_s > 0:
+            self._arm_breaker()
         from .batcher import Batcher
         self._batcher = Batcher(self)
         if start:
@@ -572,11 +669,287 @@ class ServingEngine:
         its device arrays no longer pin the model's HBM."""
         with self._lock:
             model.in_flight -= 1
+            self.last_activity = time.monotonic()
+
+    # -- stall breaker (ISSUE 12) ---------------------------------------
+    def _arm_breaker(self) -> None:
+        from ..utils.resilience import DispatchWatchdog
+        self._watchdog = DispatchWatchdog(
+            self.stall_s, on_timeout=self._on_stall, hard_exit=False)
+
+    def dispatch_section(self, label: str):
+        """Watchdog section for one device-blocking serving call
+        (dispatch / harvest) — a no-op context when the breaker is off."""
+        wd = self._watchdog
+        return _NULL_SECTION if wd is None else wd.section(label)
+
+    def _on_stall(self, label: str, elapsed: float) -> None:
+        """Watchdog monitor callback: a serving device call blew past
+        `serve_stall_s`. The hung thread cannot be interrupted (a dead
+        tunnel hangs inside C++, CLAUDE.md), but its FUTURES can be
+        failed from here — clients get a bounded DeadlineError while
+        the engine flips unhealthy and sheds new requests instead of
+        queueing them behind the wedge."""
+        self._healthy = False
+        self.stall_trips += 1
+        self._breaker = {"state": "open", "section": label,
+                         "elapsed_s": round(elapsed, 1),
+                         "time": time.time()}
+        log.error("serving: %s stalled %.1fs past the %.1fs breaker "
+                  "deadline — failing in-flight futures, shedding new "
+                  "requests until a recovery probe succeeds",
+                  label, elapsed, self.stall_s)
+        self._journal(f"serve_stall:{label}", elapsed_s=round(elapsed, 1),
+                      stall_s=self.stall_s)
+        failed = self._batcher.fail_inflight(DeadlineError(
+            f"serving dispatch {label!r} stalled past "
+            f"serve_stall_s={self.stall_s:g}s; engine unhealthy"))
+        if failed:
+            log.error("serving: failed %d in-flight request future(s) "
+                      "after the stall", failed)
+
+    def probe_recovery(self, timeout: float | None = None) -> bool:
+        """Try to close the breaker: verify the stalled call actually
+        retired (a section still open means the wedge never returned —
+        only a process restart clears that) and that a fresh tiny
+        device round-trip completes within `timeout` (default
+        `serve_stall_s`). On success the watchdog is re-armed (a trip
+        ends its monitor thread), worker threads that died are
+        respawned, and the engine serves again."""
+        if self._healthy:
+            return True
+        with self._probe_lock:
+            if self._healthy:
+                return True
+            self._last_probe = time.monotonic()
+            wd = self._watchdog
+            if wd is not None:
+                still_open = wd.open_sections()
+                if still_open:
+                    log.warning(
+                        "serving: recovery probe refused — stalled "
+                        "section %r never returned (a wedged device "
+                        "call cannot be reclaimed in-process)",
+                        still_open[0])
+                    return False
+            if not _device_probe(timeout if timeout is not None
+                                 else max(self.stall_s, 1.0)):
+                log.warning("serving: recovery probe failed; breaker "
+                            "stays open")
+                return False
+            if wd is not None:
+                wd.stop()
+            self._arm_breaker()
+            self._batcher.ensure_threads()
+            self._breaker = {"state": "closed", "recovered": time.time(),
+                             "trips": self.stall_trips}
+            self._healthy = True
+            log.info("serving: recovery probe succeeded; breaker closed")
+            self._journal("serve_recovered", trips=self.stall_trips)
+            return True
+
+    def _maybe_probe_async(self) -> None:
+        """Kick a background recovery probe at most once per breaker
+        deadline — live traffic keeps probing a dead tunnel without any
+        operator action, and without stacking probe threads."""
+        now = time.monotonic()
+        if now - self._last_probe < max(self.stall_s, 1.0):
+            return
+        self._last_probe = now
+        threading.Thread(target=self.probe_recovery, daemon=True,
+                         name="serve-recovery-probe").start()
+
+    def note_unhealthy_shed(self) -> None:
+        with self._lock:
+            self.unhealthy_sheds += 1
+
+    @property
+    def healthy(self) -> bool:
+        return self._healthy
+
+    def health(self) -> dict:
+        """/healthz payload: breaker state + last-dispatch age."""
+        idle = time.monotonic() - self.last_activity
+        return {
+            "healthy": self._healthy,
+            "breaker": self._breaker or {"state": "closed", "trips": 0},
+            "stall_trips": self.stall_trips,
+            "last_dispatch_age_s": round(idle, 3),
+            "stall_s": self.stall_s,
+        }
+
+    def ready(self) -> tuple[bool, dict]:
+        """/readyz payload: ready iff the zoo is loaded and fully
+        AOT-warmed (`compile_count == warmed_buckets`, no load in
+        flight), the breaker is closed, and the engine accepts work."""
+        with self._lock:
+            warming = self._pending_warm > 0
+            models = len(self._models)
+        doc = {
+            "models": models,
+            "warming": warming,
+            "warmed_buckets": self.warmed_buckets,
+            "compile_count": self.compile_count,
+            "healthy": self._healthy,
+            "closed": self._closed,
+        }
+        doc["ready"] = (models > 0 and not warming and not self._closed
+                        and self._healthy
+                        and self.compile_count == doc["warmed_buckets"])
+        return doc["ready"], doc
+
+    def _journal(self, reason: str, **extra) -> None:
+        """Serving run journal (`<journal>.serve.run.json`): breaker
+        trips, swaps, swap rejections, shutdown. Best-effort — a
+        journaling failure must never take serving down."""
+        if not self.journal_prefix:
+            return
+        try:
+            from ..utils import resilience
+            resilience.write_run_manifest(
+                self.journal_prefix + ".serve", reason=reason, **extra)
+        except OSError:
+            log.exception("serving: run journal failed (continuing)")
+
+    # -- verified hot-swap (ISSUE 12) -----------------------------------
+    def swap_weights(self, name: str, weights: str, *,
+                     canary: bool = True, source: str = "") -> None:
+        """Live-reload `name`'s weights from `weights` WITHOUT touching
+        its compiled bucket programs: the params tree is shape-identical
+        across weight files of one architecture, so a hot swap is a
+        host-side import + one device upload — never a recompile
+        (`compile_count` provably unchanged, the zero-recompile-swap
+        claim bench_serving measures).
+
+        The canary gate runs the smallest already-compiled bucket with
+        the CANDIDATE weights before anything reaches the serving path:
+        non-finite scores, wrong shapes, or an unloadable weights file
+        raise SwapError and the previous weights keep serving untouched
+        (rollback by staging). Callers that verified the snapshot bytes
+        first (serving/watch.py via resilience.verify_snapshot) get the
+        full train->serve trust chain."""
+        model = self.model(name)  # KeyError for unknown models
+        import jax
+        try:
+            from .. import io as _io
+            net = model.fwd._net_for(model.fwd.ladder[0])
+            params0, state0 = model.fwd.init()
+            params, state = net.import_weights(params0, state0,
+                                               _io.load_weights(weights))
+            params_host = jax.tree_util.tree_map(np.asarray, params)
+            state_host = jax.tree_util.tree_map(np.asarray, state)
+        except SwapError:
+            raise
+        except Exception as e:  # noqa: BLE001 — typed for the watcher
+            self.note_swap_rejected(name, f"weights load failed: {e}",
+                                    source=source)
+            raise SwapError(
+                f"hot-swap candidate {weights!r} failed to load: {e}"
+            ) from e
+        if FAULTS.fire("swap_canary_bad") is not None:
+            # test-only: rot the candidate so the canary must catch it
+            params_host = _poison_first_leaf(params_host)
+        if canary:
+            try:
+                self._canary_gate(model, params_host, state_host)
+            except SwapError as e:
+                self.note_swap_rejected(name, str(e), source=source)
+                raise
+        # upload OUTSIDE the lock (the _make_resident recipe): a weight
+        # device_put takes seconds over the tunnel, and a dispatcher
+        # blocked on _upload_lock inside its watchdog section for that
+        # long would false-trip the stall breaker on a healthy device.
+        # Only a CURRENTLY-RESIDENT model gets the eager upload (the
+        # new copy transiently coexists with the old until in-flight
+        # work retires — same bounded over-commit class as the LRU's
+        # in-flight deferrals); a spilled model commits its host trees
+        # alone and pays the upload at its next ensure_resident,
+        # through the budget-enforcing residency path, instead of a
+        # tunnel-length device_put that would be dropped on commit.
+        with model._upload_lock:
+            resident_now = model._resident is not None
+        uploaded = None
+        if resident_now:
+            uploaded = (jax.device_put(params_host),
+                        jax.device_put(state_host))
+        # commit under the ENGINE lock too: the LRU's victim.spill()
+        # runs under self._lock alone, and a check-then-set of
+        # _resident against it could resurrect a just-spilled model's
+        # device arrays past the HBM budget. Nesting order is
+        # _upload_lock -> engine._lock: a concurrent ensure_resident
+        # holding _upload_lock for a tunnel-length upload then only
+        # delays THIS commit, never the engine lock (and no other path
+        # holds engine._lock while waiting on an upload lock, so the
+        # nesting cannot deadlock).
+        with model._upload_lock:
+            with self._lock:
+                model.params_host = params_host
+                model.state_host = state_host
+                if model._resident is not None:
+                    # may re-spill (uploaded None: the model became
+                    # resident with the OLD weights between the checks)
+                    # — stale weights must never serve; the next
+                    # ensure_resident uploads the new masters
+                    model._resident = uploaded
+                    if uploaded is None:
+                        model.was_spilled = True
+        self.swaps += 1
+        log.info("serving: hot-swapped model %r from %s (%s); compiled "
+                 "programs untouched", name, weights, source or "manual")
+        self._journal("swap", model=name, weights=weights, source=source,
+                      swaps=self.swaps)
+
+    def _canary_gate(self, model: InferenceModel, params_host,
+                     state_host) -> None:
+        """Run the smallest ALREADY-COMPILED bucket with the candidate
+        weights on a synthetic batch. Zero compiles by construction;
+        raises SwapError on non-finite or wrong-shaped scores (the two
+        ways a structurally-loadable weights file can still be poison)."""
+        fwd = model.fwd
+        b = fwd.ladder[0]
+        rng = np.random.RandomState(0)
+        batch = rng.rand(b, *fwd.input_shape()[1:]).astype(np.float32)
+        try:
+            # one deliberate harvest: the canary must SEE the scores
+            # lint: ok(host-sync) — canary gate is a synchronous check
+            out = np.asarray(fwd.run_bucket(params_host, state_host,
+                                            batch))
+        except Exception as e:  # noqa: BLE001 — mismatch => rejection
+            raise SwapError(
+                f"canary forward failed (params do not fit the "
+                f"compiled programs): {e}") from e
+        if out.shape[0] != b or out.ndim < 1:
+            raise SwapError(
+                f"canary scores have wrong shape {out.shape} for "
+                f"bucket {b}")
+        if not np.all(np.isfinite(out)):
+            raise SwapError("canary scores are non-finite")
+
+    def note_swap_rejected(self, name: str, reason: str, *,
+                           source: str = "") -> None:
+        """Count + journal a rejected hot-swap candidate (corrupt
+        snapshot, unloadable weights, failed canary). The previous
+        weights keep serving."""
+        self.swap_rejections += 1
+        log.warning("serving: hot-swap for model %r REJECTED (%s); "
+                    "previous weights keep serving", name, reason)
+        self._journal("swap_rejected", model=name, swap_reason=reason,
+                      source=source, swap_rejections=self.swap_rejections)
 
     # -- request surface ------------------------------------------------
     def submit(self, name: str, img: np.ndarray, *, preprocess: bool = True):
         """Enqueue one image; returns a concurrent.futures.Future whose
-        result is the model's score row (np.ndarray)."""
+        result is the model's score row (np.ndarray). Typed failures
+        (ISSUE 12): EngineUnhealthyError when the stall breaker is open,
+        ShedError when the backlog is at `serve_queue_limit`,
+        EngineClosedError after close/drain."""
+        if not self._healthy:
+            self._maybe_probe_async()
+            self.note_unhealthy_shed()
+            raise EngineUnhealthyError(
+                "serving engine unhealthy (dispatch stall breaker open"
+                f"{'' if not self._breaker else ': ' + str(self._breaker.get('section'))}"
+                "); request shed")
         model = self.model(name)  # KeyError for unknown models
         data = model.preprocess(img) if preprocess else \
             np.asarray(img, np.float32)
@@ -613,6 +986,18 @@ class ServingEngine:
             "spills": self.spills,
             "reloads": self.reloads,
             "window_ms": self.window_ms,
+            # resilience telemetry (ISSUE 12)
+            "healthy": self._healthy,
+            "stall_trips": self.stall_trips,
+            "shed_requests": self._batcher.shed_count,
+            "unhealthy_sheds": self.unhealthy_sheds,
+            "deadline_failures": self._batcher.deadline_count,
+            "queue_limit": self.queue_limit,
+            "max_queue_depth": self._batcher.max_queue_depth,
+            "deadline_ms": self.deadline_ms,
+            "stall_s": self.stall_s,
+            "swaps": self.swaps,
+            "swap_rejections": self.swap_rejections,
         }
         if recs:
             lat = np.sort(np.array([r["total_ms"] for r in recs]))
@@ -630,8 +1015,29 @@ class ServingEngine:
             })
         return out
 
+    def shutdown(self, timeout: float = 60.0) -> None:
+        """Graceful drain (ISSUE 12): stop accepting (submits fail with
+        EngineClosedError), flush the open batching window immediately,
+        resolve every in-flight future, then close. The impatient path
+        (`close()`) cancels pending work instead."""
+        self._closed = True
+        self._journal("serve_shutdown", swaps=self.swaps,
+                      stall_trips=self.stall_trips)
+        self._batcher.shutdown(timeout)
+        self._stop_breaker()
+
     def close(self) -> None:
+        self._closed = True
         self._batcher.close()
+        self._stop_breaker()
+
+    def _stop_breaker(self) -> None:
+        """Retire the watchdog monitor thread with the engine — an
+        embedding app cycling engines must not accumulate pollers."""
+        wd = self._watchdog
+        if wd is not None:
+            self._watchdog = None
+            wd.stop()
 
     def __enter__(self):
         return self

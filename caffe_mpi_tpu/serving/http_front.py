@@ -12,6 +12,18 @@ image, so the surface is rebuilt on stdlib `http.server`
                             zero-egress analogue of the reference's
                             /classify_url, which fetched from the web)
   GET  /stats               serving telemetry JSON (engine.stats())
+  GET  /healthz             liveness: breaker state + last-dispatch age
+                            (200 healthy / 503 breaker open)
+  GET  /readyz              readiness: zoo loaded + every ladder warmed
+                            (compile_count == warmed_buckets) and the
+                            engine accepting (200 / 503)
+
+Failures are TYPED (ISSUE 12, serving/errors.py): a shed request under
+admission control is 429, a missed `serve_deadline_ms` is 504, a
+closed/unhealthy engine is 503 — each with a machine-readable JSON body
+`{"error": ..., "kind": "shed"|"deadline"|"closed"|"unhealthy"}` so
+clients can implement backpressure instead of parsing error prose. Bad
+uploads stay 400; only genuinely unexpected failures are 500.
 
 Unlike the reference (and this repo's pre-ISSUE-7 demo), the handler
 does NOT run the model: it submits to the ServingEngine and waits on a
@@ -33,6 +45,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 import numpy as np
+
+from .errors import ServingError
 
 _FORM = (b"<html><body><h3>caffe_mpi_tpu classification demo</h3>"
          b"<form method=post action=/classify enctype=multipart/form-data>"
@@ -101,8 +115,15 @@ class _Handler(BaseHTTPRequestHandler):
                            and i < len(self.labels) else int(i)),
                  # lint: ok(host-sync) — preds is a harvested numpy row
                  "score": float(preds[i])} for i in top]}
-        except Exception as e:
-            return self._json(500, {"error": f"classification failed: {e}"})
+        except ServingError as e:
+            # typed engine failures (ISSUE 12): shed 429, deadline 504,
+            # closed/unhealthy 503 — machine-readable, never a blanket
+            # 500 (clients key backpressure off status + kind)
+            return self._json(e.http_status,
+                              {"error": str(e), "kind": e.kind})
+        except Exception as e:  # noqa: BLE001 — anything else IS a 500
+            return self._json(500, {"error": f"classification failed: {e}",
+                                    "kind": "error"})
         self._json(200, body)
 
     def do_GET(self):
@@ -116,45 +137,60 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if url.path == "/stats":
             return self._json(200, self.engine.stats())
+        if url.path == "/healthz":
+            h = self.engine.health()
+            return self._json(200 if h["healthy"] else 503, h)
+        if url.path == "/readyz":
+            ok, doc = self.engine.ready()
+            return self._json(200 if ok else 503, doc)
         if url.path == "/classify_path":
             if not self.image_root:
-                return self._json(403, {"error": "no --image-root given"})
+                return self._json(403, {"error": "no --image-root given",
+                                        "kind": "forbidden"})
             rel = parse_qs(url.query).get("path", [""])[0]
             full = os.path.realpath(os.path.join(self.image_root, rel))
             root = os.path.realpath(self.image_root)
             if not full.startswith(root + os.sep):
-                return self._json(403, {"error": "path outside image root"})
+                return self._json(403, {"error": "path outside image root",
+                                        "kind": "forbidden"})
             try:
                 with open(full, "rb") as f:
                     raw = f.read()
             except OSError as e:
-                return self._json(404, {"error": str(e)})
+                return self._json(404, {"error": str(e), "kind": "not_found"})
             try:
                 img = decode_image(raw)
             except Exception as e:  # exists but is not an image -> 400
                 return self._json(
-                    400, {"error": f"could not decode image: {e}"})
+                    400, {"error": f"could not decode image: {e}",
+                          "kind": "bad_request"})
             return self._classify(img)
-        self._json(404, {"error": f"no route {url.path}"})
+        self._json(404, {"error": f"no route {url.path}",
+                         "kind": "not_found"})
 
     def do_POST(self):
         if urlparse(self.path).path != "/classify":
-            return self._json(404, {"error": "POST /classify"})
+            return self._json(404, {"error": "POST /classify",
+                                    "kind": "not_found"})
         if "chunked" in (self.headers.get("Transfer-Encoding") or "").lower():
             # http.server doesn't de-chunk; demand a sized body instead of
             # reading 0 bytes and emitting a confusing decode error.
             return self._json(411, {"error": "Content-Length required "
-                                             "(chunked uploads unsupported)"})
+                                             "(chunked uploads unsupported)",
+                                    "kind": "bad_request"})
         try:
             length = int(self.headers.get("Content-Length", 0))
         except ValueError:  # garbled header is a client error, not a crash
-            return self._json(400, {"error": "bad Content-Length"})
+            return self._json(400, {"error": "bad Content-Length",
+                                    "kind": "bad_request"})
         body = self.rfile.read(length)
         try:
             img = decode_image(extract_image_bytes(
                 body, self.headers.get("Content-Type", "")))
         except Exception as e:  # bad upload is a client error, not a crash
-            return self._json(400, {"error": f"could not decode image: {e}"})
+            return self._json(400,
+                              {"error": f"could not decode image: {e}",
+                               "kind": "bad_request"})
         self._classify(img)
 
     def log_message(self, fmt, *args):  # quiet by default

@@ -10,7 +10,7 @@ Usage (gflags-compatible single-dash long flags accepted):
     python -m caffe_mpi_tpu.tools.cli test -model net.prototxt -weights w.caffemodel -iterations 50
     python -m caffe_mpi_tpu.tools.cli time -model net.prototxt -iterations 50
     python -m caffe_mpi_tpu.tools.cli device_query
-    python -m caffe_mpi_tpu.tools.cli serve -model deploy.prototxt -weights w.caffemodel [-port 5000] [-smoke N]
+    python -m caffe_mpi_tpu.tools.cli serve -model deploy.prototxt -weights w.caffemodel [-port 5000] [-smoke N] [-serve_queue_limit Q] [-serve_deadline_ms D] [-serve_stall_s S] [-watch SNAPSHOT_PREFIX]
 """
 
 from __future__ import annotations
@@ -281,6 +281,37 @@ def _parser() -> argparse.ArgumentParser:
                    "of mixed sizes over real HTTP, print the telemetry "
                    "JSON (p50/p99/img_s/compile_count), assert zero "
                    "post-warmup compiles, and exit")
+    # serving resilience flags (ISSUE 12, docs/serving.md 'Resilience')
+    p.add_argument("-serve_queue_limit", "--serve-queue-limit",
+                   dest="serve_queue_limit", type=int, default=-1,
+                   help="serve: load-shedding admission control — a "
+                   "submit arriving with this many requests already "
+                   "backlogged fails fast with HTTP 429 instead of "
+                   "queueing unboundedly (overrides ServingParameter "
+                   "serve_queue_limit; -1 = schema default 0 = "
+                   "unbounded)")
+    p.add_argument("-serve_deadline_ms", "--serve-deadline-ms",
+                   dest="serve_deadline_ms", type=float, default=-1.0,
+                   help="serve: per-request dispatch deadline — a "
+                   "request whose batch cannot dispatch this soon "
+                   "after arrival fails with HTTP 504 at window close "
+                   "(overrides ServingParameter serve_deadline_ms; "
+                   "-1 = schema default 0 = no deadline)")
+    p.add_argument("-serve_stall_s", "--serve-stall-s",
+                   dest="serve_stall_s", type=float, default=-1.0,
+                   help="serve: dispatch stall breaker — a device call "
+                   "blocked this many seconds (dead tunnel) fails the "
+                   "in-flight futures, journals, flips /healthz to 503 "
+                   "and sheds new requests until a recovery probe "
+                   "succeeds (overrides ServingParameter serve_stall_s; "
+                   "-1 = schema default 0 = breaker off)")
+    p.add_argument("-watch", "--watch", dest="serve_watch", default="",
+                   help="serve: snapshot prefix to tail for verified "
+                   "hot-swaps — each newly crc32c-verified snapshot is "
+                   "canary-gated and live-reloaded into the serving "
+                   "model with zero recompiles; rejects (corrupt bytes, "
+                   "non-finite canary) are journaled and the previous "
+                   "weights keep serving")
     return p
 
 
@@ -954,8 +985,21 @@ def cmd_serve(args) -> int:
         sp.serve_hbm_mb = args.serve_hbm_mb
     if args.serve_dtype:
         sp.serve_dtype = args.serve_dtype
-    engine = ServingEngine(sp)
+    if args.serve_queue_limit >= 0:
+        sp.serve_queue_limit = args.serve_queue_limit
+    if args.serve_deadline_ms >= 0:
+        sp.serve_deadline_ms = args.serve_deadline_ms
+    if args.serve_stall_s >= 0:
+        sp.serve_stall_s = args.serve_stall_s
+    # serving run journal (<model>.serve.run.json): breaker trips, hot
+    # swaps + rejections, shutdown — next to the deploy prototxt
+    engine = ServingEngine(sp, journal=os.path.splitext(args.model)[0])
     engine.load_model("default", args.model, args.weights or None)
+    watcher = None
+    if args.serve_watch:
+        from ..serving.watch import SnapshotWatcher
+        watcher = SnapshotWatcher(engine, "default", args.serve_watch)
+        watcher.start()
     srv = make_server(engine, "default", labels=args.labels or None,
                       image_root=args.image_root or None,
                       port=args.port if not args.smoke else 0)
@@ -969,10 +1013,18 @@ def cmd_serve(args) -> int:
         except KeyboardInterrupt:
             pass
         finally:
+            if watcher is not None:
+                watcher.stop()
             srv.shutdown()
-            engine.close()
+            # graceful: stop accepting, flush the window, resolve every
+            # in-flight future, then close (docs/serving.md Resilience)
+            engine.shutdown()
         return 0
-    return _serve_smoke(args, engine, srv)
+    try:
+        return _serve_smoke(args, engine, srv)
+    finally:
+        if watcher is not None:
+            watcher.stop()
 
 
 def _serve_smoke(args, engine, srv) -> int:

@@ -59,6 +59,9 @@ KNOBS = (
     "hosts",            # ISSUE 11: elastic multi-host cluster size
     "coordinator",      # ISSUE 11: coordination-service address
     "host_deadline",    # ISSUE 11: cross-host heartbeat deadline
+    "serve_queue_limit",  # ISSUE 12: load-shedding admission control
+    "serve_deadline_ms",  # ISSUE 12: per-request dispatch deadline
+    "serve_stall_s",    # ISSUE 12: serving dispatch stall breaker
 )
 
 CONFIG_FILE = os.path.join("caffe_mpi_tpu", "proto", "config.py")

@@ -146,6 +146,12 @@ FAULT_SITES = {
                         "attempts (missing/unreachable coordinator)",
     "snapshot_shard_corrupt": "flip a byte in one orbax shard "
                               "post-manifest (sharded-snapshot bitrot)",
+    "serve_dispatch_stall": "sleep inside a serving dispatch (stall "
+                            "breaker trip — the dead-tunnel shape)",
+    "swap_corrupt": "flip a byte of a hot-swap candidate's model file "
+                    "post-manifest (verify must reject the swap)",
+    "swap_canary_bad": "poison a hot-swap candidate's loaded weights "
+                       "with NaN (canary gate must roll back)",
 }
 
 class FaultPlane:
@@ -974,6 +980,14 @@ class DispatchWatchdog:
         self._stop.set()
         self._thread.join(timeout=2 * self.poll + 1.0)
 
+    def open_sections(self) -> list[str]:
+        """Labels of the currently-open device sections, oldest first —
+        the serving breaker's recovery gate asks this to tell a retired
+        stall from a still-wedged call (serving/engine.py)."""
+        with self._lock:
+            entries = sorted(self._open.values(), key=lambda lt: lt[1])
+        return [label for label, _t0 in entries]
+
     def _run(self) -> None:
         while not self._stop.wait(self.poll):
             if self.pulse is not None:
@@ -992,10 +1006,18 @@ class DispatchWatchdog:
             elapsed = now - t0
             if elapsed <= self.deadline:
                 continue
+            # the consequence differs by mode and the operator reads
+            # this line: the training watchdog hard-exits 86, the
+            # serving breaker (hard_exit=False, ISSUE 12) keeps the
+            # process alive and sheds — claiming "exiting" there sends
+            # an operator hunting for a death that never happened
+            action = (f"journaling run state and hard-exiting "
+                      f"{EXIT_WATCHDOG}" if self.hard_exit else
+                      "journaling and tripping the breaker (process "
+                      "stays up)")
             log.error("watchdog: device %s exceeded %.1fs deadline "
-                      "(%.1fs elapsed) — journaling run state and "
-                      "hard-exiting %d", label, self.deadline, elapsed,
-                      EXIT_WATCHDOG)
+                      "(%.1fs elapsed) — %s", label, self.deadline,
+                      elapsed, action)
             try:
                 if self.on_timeout is not None:
                     self.on_timeout(label, elapsed)

@@ -140,11 +140,168 @@ def main() -> int:
     }
     eng2.close()
 
+    # swap-under-traffic phase (ISSUE 12): live traffic ACROSS a
+    # verified hot-swap — the watcher verifies the snapshot's crc32c
+    # manifest, canary-gates the candidate on an already-compiled
+    # bucket, and swaps weights WITHOUT touching the compiled ladder.
+    # Enforced claims: zero post-warmup compiles and p99 within 1.5x
+    # the phase's OWN pre-swap baseline (the identical paced trace run
+    # twice — comparing against phase 1's unpaced flood would make the
+    # bound vacuous).
+    stats["swap"] = swap_phase(paths["conv"], shapes["conv"], tmp)
+
+    # overload-shed phase (ISSUE 12): offered load > capacity against a
+    # tight serve_queue_limit — typed sheds, backlog provably bounded
+    stats["shed"] = shed_phase(paths["mlp"], shapes["mlp"])
+
     import jax
     stats["platform"] = jax.devices()[0].platform
     print(json.dumps({"serving": stats}))
-    return 0 if (stats["zero_recompile"]
-                 and stats["budgeted"]["zero_recompile"]) else 1
+    ok = (stats["zero_recompile"]
+          and stats["budgeted"]["zero_recompile"]
+          and stats["swap"]["ok"] and stats["shed"]["ok"])
+    return 0 if ok else 1
+
+
+def swap_phase(model_path: str, shape, tmp: str) -> dict:
+    import numpy as np
+    import caffe_mpi_tpu.pycaffe as caffe
+    from caffe_mpi_tpu.serving import ServingEngine, SnapshotWatcher
+    from caffe_mpi_tpu.utils import resilience
+    # the one spelling of "publish a verified snapshot set" shared with
+    # the serve-watch smoke (tools/ is not a package; _ROOT is already
+    # on sys.path for the caffe_mpi_tpu import above)
+    sys.path.insert(0, os.path.join(_ROOT, "tools"))
+    from serve_watch_smoke import publish
+
+    net = caffe.Net(model_path, caffe.TEST)
+    w1 = os.path.join(tmp, "swap_w1.caffemodel")
+    net.save(w1)
+    prefix = os.path.join(tmp, "swap_snap")
+
+    eng = ServingEngine(window_ms=WINDOW_MS)
+    eng.load_model("m", model_path, w1)
+    warmed = eng.compile_count
+    rng = np.random.RandomState(2)
+    h, w, c = shape
+    maxb = eng.model("m").fwd.ladder[-1]
+
+    def paced_trace():
+        """One paced mixed-size trace; returns its own p99 (records
+        sliced to THIS trace so the two runs are comparable)."""
+        seen = len(eng._batcher.records())
+        futures = []
+        sent = 0
+        while sent < REQUESTS:
+            burst = int(rng.randint(1, maxb + 1))
+            for _ in range(min(burst, REQUESTS - sent)):
+                futures.append(eng.submit(
+                    "m", rng.rand(h, w, c).astype(np.float32)))
+                sent += 1
+            time.sleep(0.002)  # paced: the swap must land MID-traffic
+        eng.drain(timeout=120)
+        for f in futures:
+            f.result(timeout=1)
+        lat = [r["total_ms"] for r in eng._batcher.records()[seen:]]
+        return sent, float(np.percentile(np.array(lat), 99))
+
+    # the identical trace, first without the watcher (the baseline),
+    # then with the watcher swapping MID-trace — apples to apples. The
+    # during-trace requirement is enforced, not assumed: each attempt
+    # publishes a fresh verified snapshot and only a trace whose
+    # swap-counter advanced while it ran counts (a swap landing between
+    # traces would silently compare two no-swap traces); a slow host
+    # gets three attempts before the phase reports failure. The
+    # baseline is the MAX of two runs of the same trace: at CPU-forced
+    # ~5 ms p99s a single run's p99 jitters tens of percent on a
+    # shared host, and a falsely tight baseline fails the ratio bound
+    # without any swap regression.
+    n_base1, p99_b1 = paced_trace()
+    n_base2, p99_b2 = paced_trace()
+    n_total, p99_base = n_base1 + n_base2, max(p99_b1, p99_b2)
+    watcher = SnapshotWatcher(eng, "m", prefix, poll_s=0.05)
+    watcher.start()
+    p99_swap = None
+    swap_during_trace = False
+    for attempt in range(3):
+        net.params["ip"][0].data = net.params["ip"][0].data * 3.0
+        publish(prefix, 10 * (attempt + 1), net, resilience)
+        s0 = eng.swaps
+        n, p99 = paced_trace()
+        n_total += n
+        if eng.swaps > s0:
+            p99_swap = p99
+            swap_during_trace = True
+            break
+        # not yet: let the pending swap land, then retry with a new one
+        deadline = time.time() + 10
+        while eng.swaps == s0 and time.time() < deadline:
+            time.sleep(0.01)
+    watcher.stop()
+    eng.close()
+    ratio = (p99_swap / p99_base) if (p99_base and p99_swap) else None
+    out = {
+        "requests": n_total,
+        "swaps": eng.swaps,
+        "swap_rejections": eng.swap_rejections,
+        "swap_during_trace": swap_during_trace,
+        "p99_ms": round(p99_swap, 3) if p99_swap else None,
+        "baseline_p99_ms": round(p99_base, 3),
+        "p99_ratio_vs_baseline": round(ratio, 3) if ratio else None,
+        "post_warmup_compiles": eng.compile_count - warmed,
+        "zero_recompile_during_swap": (
+            eng.compile_count == warmed
+            and eng.compile_count == eng.warmed_buckets),
+        # the enforced bound is the 1.5x ratio; the 5 ms absolute floor
+        # only absorbs scheduler jitter on the CPU-forced run (p99 ~5
+        # ms here) — at real tunnel latencies (tens of ms) the ratio
+        # term dominates and the floor is inert
+        "p99_held": (p99_swap is not None
+                     and p99_swap <= max(1.5 * p99_base,
+                                         p99_base + 5.0)),
+    }
+    out["ok"] = (eng.swaps >= 1 and swap_during_trace
+                 and out["zero_recompile_during_swap"]
+                 and out["p99_held"])
+    return out
+
+
+def shed_phase(model_path: str, shape, limit: int = 8,
+               offered: int = 200) -> dict:
+    import numpy as np
+    from caffe_mpi_tpu.serving import ServingEngine, ShedError
+
+    # a generous window parks the backlog so admission control — not
+    # dispatch speed — decides; accepted requests still all complete
+    eng = ServingEngine(window_ms=25, queue_limit=limit)
+    eng.load_model("m", model_path)
+    rng = np.random.RandomState(3)
+    h, w, c = shape
+    futures = []
+    shed = 0
+    for _ in range(offered):
+        try:
+            futures.append(eng.submit(
+                "m", rng.rand(h, w, c).astype(np.float32)))
+        except ShedError:
+            shed += 1
+    eng.drain(timeout=120)
+    for f in futures:
+        f.result(timeout=1)
+    st = eng.stats()
+    eng.close()
+    out = {
+        "queue_limit": limit,
+        "offered": offered,
+        "accepted": len(futures),
+        "shed": shed,
+        "max_queue_depth": st["max_queue_depth"],
+        "depth_bounded": st["max_queue_depth"] <= limit,
+    }
+    out["ok"] = (out["depth_bounded"] and shed > 0
+                 and shed == st["shed_requests"]
+                 and len(futures) + shed == offered)
+    return out
 
 
 if __name__ == "__main__":

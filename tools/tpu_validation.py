@@ -13,9 +13,13 @@ rest:
   6. `caffe serve -smoke` — the inference serving plane (ISSUE 7) on
      real hardware: AOT bucket warm, continuous batching over real
      HTTP, zero post-warmup compiles asserted, p50/p99 + img/s printed
-  7. AlexNet trained from a real LMDB through the full host pipeline
+  7. `serve-watch` (ISSUE 12) — verified hot-swap over the real
+     tunnel: a watcher swaps a crc32c-verified snapshot into the live
+     engine (canary forward on-chip, zero recompiles) and rejects a
+     corrupted one (tools/serve_watch_smoke.py)
+  8. AlexNet trained from a real LMDB through the full host pipeline
      (tools/e2e_lmdb_train.py) -> e2e img/s vs the synthetic-feed bench
-  8. `train-multihost` (ISSUE 11) — 2-process elastic cluster,
+  9. `train-multihost` (ISSUE 11) — 2-process elastic cluster,
      host_loss-injected worker kill -> journaled exit-87 -> coordinated
      supervised recovery, final weights bit-identical to an
      uninterrupted baseline (tools/multihost_smoke.py)
@@ -206,6 +210,15 @@ for causal in (False, True):
                  "-model", "models/cifar10_quick/deploy.prototxt",
                  "-smoke", "64", "-serve_window_ms", "10"],
                 600, log)
+            # verified hot-swap over the real tunnel (ISSUE 12,
+            # docs/serving.md Resilience): a SnapshotWatcher tails a
+            # snapshot prefix while the engine serves — a verified
+            # 3x-scaled snapshot must swap in (zero recompiles, scores
+            # visibly change, canary forward runs on the chip) and a
+            # post-manifest-corrupted one must be rejected with the
+            # serving weights bitwise untouched
+            run("serve-watch",
+                [py, "tools/serve_watch_smoke.py"], 600, log)
             # flagship fed from a REAL LMDB through the host pipeline —
             # the e2e img/s vs the synthetic-feed bench quantifies the
             # pipeline cost on hardware (VERDICT r4 weak #3). The LMDB

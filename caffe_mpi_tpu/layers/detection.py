@@ -139,6 +139,8 @@ class DetectNetTransformationLayer(Layer):
         self._coverage_label = coverage_label
         self._mean = (np.asarray(self.mean_values, np.float32)
                       if self.mean_values else None)
+        # lint: ok(thread-shared-mutation) — setup() completes before
+        # the graph (and its callbacks) can run; no thread exists yet
         self._warned_single_slot = False
         gh, gw = gt.image_size_y // gt.stride, gt.image_size_x // gt.stride
         self._out_shapes = [(n, 3, gt.image_size_y, gt.image_size_x),
@@ -154,6 +156,9 @@ class DetectNetTransformationLayer(Layer):
         label = np.asarray(label)
         seed = int(seed)
         if not self._warned_single_slot:
+            # lint: ok(thread-shared-mutation) — setup() runs before the
+            # first callback can fire; a lost race between callback
+            # threads costs one duplicated warning, nothing more
             self._warned_single_slot = True
             if (jax.default_backend() == "cpu"
                     and len(jax.local_devices()) < 2):

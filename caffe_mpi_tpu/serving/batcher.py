@@ -94,6 +94,9 @@ class Batcher:
                              ("serve-harvest", self._harvest_loop)):
             t = threading.Thread(target=target, name=name, daemon=True)
             t.start()
+            # lint: ok(thread-shared-mutation) — callers serialize:
+            # submit() holds _cv, and the engine constructor runs
+            # before any worker thread exists
             self._threads.append(t)
 
     def close(self) -> None:
@@ -114,6 +117,9 @@ class Batcher:
         self._harvest_q.put(None)
         for t in self._threads[1:]:
             t.join(timeout=10)
+        # lint: ok(thread-shared-mutation) — the workers were joined
+        # (or declared wedged and abandoned) just above, and
+        # ensure_threads refuses to respawn once _stop is set
         self._threads = []
         # a dispatch that outlived the join enqueues AFTER the sentinel,
         # into a queue nobody reads — fail those futures instead of
@@ -225,15 +231,23 @@ class Batcher:
         preserving the arrival order of every other model."""
         group, keep = [], deque()
         while self._pending and len(group) < max_bucket:
+            # lint: ok(thread-shared-mutation) — caller holds _cv: the
+            # dispatcher pops the queue inside its condition-variable
+            # span (_dispatch_loop), the discipline LOCK_ORDER documents
             req = self._pending.popleft()
             (group if req.model == model else keep).append(req)
         keep.extend(self._pending)
+        # lint: ok(thread-shared-mutation) — caller holds _cv (same
+        # contract as the popleft scan above)
         self._pending = keep
         if group:
             left = self._pending_by_model.get(model, 0) - len(group)
             if left > 0:
+                # lint: ok(thread-shared-mutation) — caller holds _cv
+                # (same contract as the deque scan above)
                 self._pending_by_model[model] = left
             else:
+                # lint: ok(thread-shared-mutation) — caller holds _cv
                 self._pending_by_model.pop(model, None)
         return group
 

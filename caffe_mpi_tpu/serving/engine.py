@@ -133,7 +133,6 @@ def _device_probe(timeout: float) -> bool:
             import jax
             x = jax.device_put(np.ones((8,), np.float32))
             # a real round-trip, not just an enqueue
-            # lint: ok(host-sync) — the probe IS the round-trip
             np.asarray(x + 1.0)
             ok.append(True)
         except Exception:  # noqa: BLE001 — any failure = not recovered
@@ -283,6 +282,10 @@ class BucketedForward:
 
             feeds_struct = {in_blob: jax.ShapeDtypeStruct(
                 net.blob_shapes[in_blob], np.float32)}
+            # lint: ok(blocking-under-lock) — serializing the compile IS
+            # this lock's purpose: racing warmers must not build the same
+            # bucket program twice, and steady-state serving never takes
+            # this path (compile_count == warmed_buckets is the invariant)
             compiled = jax.jit(fwd).lower(params, state,
                                           feeds_struct).compile()
             self.counter.bump()
@@ -403,6 +406,11 @@ class InferenceModel:
         with self._upload_lock:
             if self._resident is None:
                 import jax
+                # lint: ok(blocking-under-lock) — upload serialization is
+                # this per-model lock's purpose (two racers must not pay
+                # the multi-second device_put twice); engine._lock is
+                # NEVER held here (LOCK_ORDER: _upload_lock -> _lock), so
+                # the stall is private to this model's upload
                 self._resident = (jax.device_put(self.params_host),
                                   jax.device_put(self.state_host))
             return self._resident
@@ -674,6 +682,9 @@ class ServingEngine:
     # -- stall breaker (ISSUE 12) ---------------------------------------
     def _arm_breaker(self) -> None:
         from ..utils.resilience import DispatchWatchdog
+        # lint: ok(thread-shared-mutation) — callers serialize: __init__
+        # runs before any thread exists, probe_recovery holds _probe_lock,
+        # and _stop_breaker (the only other writer) takes _probe_lock too
         self._watchdog = DispatchWatchdog(
             self.stall_s, on_timeout=self._on_stall, hard_exit=False)
 
@@ -721,6 +732,11 @@ class ServingEngine:
         with self._probe_lock:
             if self._healthy:
                 return True
+            if self._closed:
+                # a probe thread that lost the race with close() must
+                # not re-arm a fresh watchdog (a monitor thread nobody
+                # would ever stop) or flip a closed engine healthy
+                return False
             self._last_probe = time.monotonic()
             wd = self._watchdog
             if wd is not None:
@@ -736,6 +752,11 @@ class ServingEngine:
                                  else max(self.stall_s, 1.0)):
                 log.warning("serving: recovery probe failed; breaker "
                             "stays open")
+                return False
+            if self._closed:
+                # defense in depth: _mark_closed publishes under
+                # _probe_lock, so this is unreachable while we hold it
+                # — kept against a future lock-free _closed writer
                 return False
             if wd is not None:
                 wd.stop()
@@ -755,6 +776,11 @@ class ServingEngine:
         now = time.monotonic()
         if now - self._last_probe < max(self.stall_s, 1.0):
             return
+        # lint: ok(thread-shared-mutation) — deliberate lock-free
+        # throttle: taking _probe_lock here would park every submit()
+        # caller behind an in-flight recovery probe for up to stall_s;
+        # the worst a lost race costs is one redundant probe thread,
+        # and probe_recovery itself serializes under _probe_lock
         self._last_probe = now
         threading.Thread(target=self.probe_recovery, daemon=True,
                          name="serve-recovery-probe").start()
@@ -911,7 +937,6 @@ class ServingEngine:
         batch = rng.rand(b, *fwd.input_shape()[1:]).astype(np.float32)
         try:
             # one deliberate harvest: the canary must SEE the scores
-            # lint: ok(host-sync) — canary gate is a synchronous check
             out = np.asarray(fwd.run_bucket(params_host, state_host,
                                             batch))
         except Exception as e:  # noqa: BLE001 — mismatch => rejection
@@ -1020,23 +1045,36 @@ class ServingEngine:
         EngineClosedError), flush the open batching window immediately,
         resolve every in-flight future, then close. The impatient path
         (`close()`) cancels pending work instead."""
-        self._closed = True
+        self._mark_closed()
         self._journal("serve_shutdown", swaps=self.swaps,
                       stall_trips=self.stall_trips)
         self._batcher.shutdown(timeout)
         self._stop_breaker()
 
     def close(self) -> None:
-        self._closed = True
+        self._mark_closed()
         self._batcher.close()
         self._stop_breaker()
 
+    def _mark_closed(self) -> None:
+        """Publish _closed under _probe_lock: probe_recovery holds that
+        lock across its whole body, so either the probe commits (and
+        journals serve_recovered) strictly BEFORE close proceeds, or it
+        observes _closed and refuses — never a recovered-after-shutdown
+        journal or a healthy /healthz on a closed engine."""
+        with self._probe_lock:
+            self._closed = True
+
     def _stop_breaker(self) -> None:
         """Retire the watchdog monitor thread with the engine — an
-        embedding app cycling engines must not accumulate pollers."""
-        wd = self._watchdog
-        if wd is not None:
+        embedding app cycling engines must not accumulate pollers.
+        Serialized against probe_recovery's re-arm via _probe_lock: a
+        close() racing a recovery probe must not leave the freshly
+        re-armed watchdog's monitor thread running forever."""
+        with self._probe_lock:
+            wd = self._watchdog
             self._watchdog = None
+        if wd is not None:
             wd.stop()
 
     def __enter__(self):

@@ -1305,7 +1305,7 @@ class Solver:
         if gstate is None:
             return
         with self._guard("guard check"):
-            # host-sync: ok (chunk boundary, 5 scalars, one transfer)
+            # chunk boundary: 5 scalars, one transfer — not per-iteration
             vals = jax.device_get(gstate)
         # max_consec = longest burst seen over the RUN (monotone in the
         # carry; reset only by restore()): a >=M run that recovered
@@ -2040,6 +2040,8 @@ class Solver:
             t.join()
         err = getattr(self, "_snapshot_error", None)
         if err is not None:
+            # lint: ok(thread-shared-mutation) — the writer thread was
+            # joined above; the happens-before edge is the join
             self._snapshot_error = None
             it, exc = err
             raise RuntimeError(
@@ -2049,6 +2051,9 @@ class Solver:
         try:
             self._write_snapshot(*view)
         except BaseException as e:  # surfaced by wait_snapshots
+            # lint: ok(thread-shared-mutation) — single writer thread,
+            # and wait_snapshots() JOINS it before reading/clearing, so
+            # the happens-before edge is the join, not a lock
             self._snapshot_error = (view[3], e)
 
     def _write_snapshot(self, params, net_state, opt_state, it,
@@ -2098,6 +2103,9 @@ class Solver:
         # test-only: post-manifest bitrot — the crc check on load must
         # catch it and resume must fall back to an older snapshot
         FAULTS.corrupt_file("snapshot_corrupt", model_path)
+        # lint: ok(thread-shared-mutation) — at most one snapshot writer
+        # is ever in flight (wait_snapshots() joins the previous one
+        # before the next dispatch or any blocking write starts)
         self._last_snapshot = (it, state_path)
         self._journal_run_state("snapshot")
         if jax.process_count() > 1:
@@ -2196,6 +2204,8 @@ class Solver:
             shards = resilience.sharded_snapshot_files(path)
             if shards:
                 FAULTS.corrupt_file("snapshot_shard_corrupt", shards[0])
+        # lint: ok(thread-shared-mutation) — blocking path: callers run
+        # wait_snapshots() first, so no async writer is in flight
         self._last_snapshot = (it, path)
         self._journal_run_state("snapshot")
         if jax.process_count() > 1:
@@ -2280,6 +2290,8 @@ class Solver:
                 log.exception("verified snapshot at iter %d failed to "
                               "load; falling back", it)
                 continue
+            # lint: ok(thread-shared-mutation) — resume happens before
+            # training starts; no snapshot writer exists yet
             self._last_snapshot = (it, doc["state"])
             return doc["state"]
         # legacy snapshots with no manifest sidecar: newest iteration
@@ -2313,6 +2325,8 @@ class Solver:
                 continue
             log.warning("resumed from legacy (unverified) snapshot %s",
                         path)
+            # lint: ok(thread-shared-mutation) — resume happens before
+            # training starts; no snapshot writer exists yet
             self._last_snapshot = (it, path)
             return path
         log.info("no usable snapshot under prefix %r; starting fresh",

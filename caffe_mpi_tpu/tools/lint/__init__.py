@@ -25,9 +25,17 @@ Framework shape:
   the host-sync pass (compat with pre-framework annotations)
 - a waiver naming an unknown pass is itself a finding (bad-waiver):
   a misspelled waiver must fail the run, never silently suppress
+- a waiver whose named pass no longer produces any finding on its
+  statement is reported as stale (stale-waiver, default-on at the CLI,
+  `--no-stale` to silence): the waiver inventory must not rot as
+  passes and code evolve. Passes that apply waivers themselves
+  (doc-drift, knob-drift — `self_waiving = True`) are exempt.
 - CLI: `python -m caffe_mpi_tpu.tools.lint [--select P,...] [--json]
-  [paths...]`; default paths are the shipped tree (caffe_mpi_tpu/,
-  tools/, bench.py); exit 1 on any finding
+  [--changed REF] [--no-stale] [paths...]`; default paths are the
+  shipped tree (caffe_mpi_tpu/, tools/, bench.py); `--changed REF`
+  lints only files named by `git diff --name-only REF` (plus explicit
+  paths) for fast pre-commit runs — a typo'd ref is a usage error
+  (exit 2), never a false-clean exit 0; exit 1 on any finding
 
 See docs/static_analysis.md for the pass catalog and how to add one.
 """
@@ -154,20 +162,43 @@ class FileContext:
         text = self.lines[ln - 1] if 0 < ln <= len(self.lines) else ""
         return text.lstrip().startswith("#")
 
-    def waived(self, span: tuple[int, int] | None, pass_name: str) -> bool:
-        """A waiver counts anywhere in the statement's span (trailing
-        comments included), or on the line directly above IF that line
-        is comment-only — a trailing waiver on the PREVIOUS statement
-        must not silently leak onto the next one."""
+    def waiver_lines(self, span: tuple[int, int] | None,
+                     pass_name: str) -> list[int]:
+        """Lines whose waiver suppresses a finding with this span (see
+        `span_waiver_lines` — ONE implementation of the binding
+        contract, shared with passes that self-apply waivers). The
+        caller records these as HONORED so stale-waiver detection knows
+        which waivers still earn their keep."""
         if span is None:
-            return False
-        lo, hi = span
-        if any(pass_name in self.waivers.get(ln, ())
-               for ln in range(lo, hi + 1)):
-            return True
-        above = lo - 1
-        return (above >= 1 and self.comment_only(above)
-                and pass_name in self.waivers.get(above, ()))
+            return []
+        return span_waiver_lines(span, pass_name, self.waivers,
+                                 self.lines)
+
+    def waived(self, span: tuple[int, int] | None, pass_name: str) -> bool:
+        return bool(self.waiver_lines(span, pass_name))
+
+
+def span_waiver_lines(span: tuple[int, int], pass_name: str,
+                      waivers: dict[int, set[str]],
+                      lines: list[str]) -> list[int]:
+    """THE waiver-binding contract, in one place (FileContext and the
+    self-waiving passes both delegate here — two copies of this walk
+    drifted once and must not again): a waiver binds anywhere in the
+    statement's span (trailing comments included), or anywhere in the
+    contiguous COMMENT-ONLY block directly above it (a multi-line
+    waiver comment binds to the statement it precedes; a trailing
+    waiver on the PREVIOUS statement is not comment-only and so cannot
+    leak onto the next one)."""
+    lo, hi = span
+    out = [ln for ln in range(lo, hi + 1)
+           if pass_name in waivers.get(ln, ())]
+    above = lo - 1
+    while 1 <= above <= len(lines) \
+            and lines[above - 1].lstrip().startswith("#"):
+        if pass_name in waivers.get(above, ()):
+            out.append(above)
+        above -= 1
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -176,10 +207,14 @@ class FileContext:
 class LintPass:
     """Base class. Subclasses set `name` + `description` and override
     `check` (per-file) and/or `check_tree` (whole-run, for cross-file
-    invariants). Yield `Finding`s; the framework applies waivers."""
+    invariants). Yield `Finding`s; the framework applies waivers.
+    Passes that apply waivers THEMSELVES (whole-tree scans over files
+    the caller didn't select, e.g. doc-drift) set `self_waiving = True`
+    so stale-waiver detection does not misread their waivers as dead."""
 
     name: str = ""
     description: str = ""
+    self_waiving: bool = False
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         return iter(())
@@ -201,8 +236,9 @@ def register(cls: type[LintPass]) -> type[LintPass]:
 
 def _load_passes() -> None:
     # import for side effect: each module registers its pass(es)
-    from . import (concrete_init, doc_drift, gated_imports,  # noqa: F401
-                   host_sync, knob_drift, reference_citation, traced_flow)
+    from . import (concrete_init, concurrency, doc_drift,  # noqa: F401
+                   gated_imports, host_sync, knob_drift,
+                   reference_citation, traced_flow)
 
 
 # ---------------------------------------------------------------------------
@@ -244,10 +280,15 @@ def _bad_waiver_findings(ctx: FileContext,
 
 def run_lint(paths: Iterable[str] | None = None,
              select: Iterable[str] | None = None,
-             root: str | None = None) -> list[Finding]:
+             root: str | None = None,
+             stale: bool = False) -> list[Finding]:
     """Run the selected passes (default: all) over `paths` (default:
     the shipped tree under `root`). Returns waiver-filtered findings,
-    ordered by path then line."""
+    ordered by path then line. `stale=True` (the CLI default; library
+    default off for fixture ergonomics) additionally reports every
+    waiver in the scanned files whose named pass — when selected and
+    not self-waiving — no longer suppresses any finding on its
+    statement."""
     _load_passes()
     root = root or repo_root()
     if paths is None:
@@ -280,6 +321,9 @@ def run_lint(paths: Iterable[str] | None = None,
 
     ctxs: list[FileContext] = []
     findings: list[Finding] = []
+    # (path, line, pass) of every waiver that suppressed a finding —
+    # the evidence stale-waiver detection subtracts from the inventory
+    honored: set[tuple[str, int, str]] = set()
     for path in iter_py_files(paths):
         ctx = FileContext(path, root=root)
         if ctx.syntax_error is not None:
@@ -292,15 +336,42 @@ def run_lint(paths: Iterable[str] | None = None,
         ctxs.append(ctx)
         findings.extend(_bad_waiver_findings(ctx, set(REGISTRY)))
         for p in passes:
-            findings.extend(f for f in p.check(ctx)
-                            if not ctx.waived(f.span, p.name))
+            for f in p.check(ctx):
+                lines = ctx.waiver_lines(f.span, p.name)
+                if lines:
+                    honored.update((ctx.path, ln, p.name)
+                                   for ln in lines)
+                else:
+                    findings.append(f)
     for p in passes:
         findings.extend(p.check_tree(ctxs, root))
     # tree findings from files in ctxs honor waivers too
     by_path = {c.path: c for c in ctxs}
-    findings = [f for f in findings
-                if not (f.pass_name in selected and f.path in by_path
-                        and by_path[f.path].waived(f.span, f.pass_name))]
+    kept = []
+    for f in findings:
+        if f.pass_name in selected and f.path in by_path:
+            lines = by_path[f.path].waiver_lines(f.span, f.pass_name)
+            if lines:
+                honored.update((f.path, ln, f.pass_name)
+                               for ln in lines)
+                continue
+        kept.append(f)
+    findings = kept
+    if stale:
+        # a waiver for a selected, non-self-waiving pass that matched
+        # no finding suppresses nothing — the inventory is rotting
+        eligible = {p.name for p in passes if not p.self_waiving}
+        for ctx in ctxs:
+            for ln in sorted(ctx.waivers):
+                for name in sorted(ctx.waivers[ln] & eligible):
+                    if (ctx.path, ln, name) not in honored:
+                        findings.append(Finding(
+                            "stale-waiver", ctx.path, ln,
+                            f"stale waiver: pass {name!r} reports no "
+                            "finding on this statement any more — "
+                            "remove the waiver (or run with "
+                            "--no-stale to silence this check)",
+                            span=None, detail=name))
     findings.sort(key=lambda f: (f.path, f.line, f.pass_name))
     return findings
 
@@ -359,6 +430,13 @@ def main(argv: list[str] | None = None) -> int:
                     help="emit findings as a JSON array")
     ap.add_argument("--list", action="store_true", dest="list_passes",
                     help="list registered passes and exit")
+    ap.add_argument("--changed", metavar="REF", default=None,
+                    help="lint only .py files named by `git diff "
+                         "--name-only REF` (plus explicit paths) — "
+                         "fast pre-commit mode; a bad REF exits 2")
+    ap.add_argument("--no-stale", action="store_true", dest="no_stale",
+                    help="skip stale-waiver detection (waivers whose "
+                         "pass no longer fires on their statement)")
     args = ap.parse_args(argv)
     if args.list_passes:
         for name in sorted(REGISTRY):
@@ -367,8 +445,46 @@ def main(argv: list[str] | None = None) -> int:
     select = ([s.strip() for s in args.select.split(",") if s.strip()]
               if args.select else None)
     root = repo_root()
+    paths = list(args.paths)
+    if args.changed is not None:
+        import subprocess
+        proc = subprocess.run(
+            ["git", "diff", "--name-only", args.changed, "--"],
+            cwd=root, capture_output=True, text=True)
+        if proc.returncode != 0:
+            # a typo'd ref MUST be a usage error, never a false-clean
+            # exit 0 with zero files scanned
+            sys.stderr.write(proc.stderr or
+                             f"git diff --name-only {args.changed} "
+                             "failed\n")
+            return 2
+        # only files the default scan would cover: tests/ and examples/
+        # are deliberately OUTSIDE the lint contract (torch-oracle
+        # host syncs etc.), and a pre-commit run must not fail on code
+        # the full-tree run deliberately exempts
+        dir_roots = tuple(t + "/" for t in DEFAULT_SCAN
+                          if not t.endswith(".py"))
+        changed = [os.path.join(root, rel)
+                   for rel in (line.strip()
+                               for line in proc.stdout.splitlines())
+                   if rel.endswith(".py")
+                   and (rel in DEFAULT_SCAN
+                        or rel.startswith(dir_roots))]
+        # deleted files appear in the diff but no longer exist; new
+        # UNTRACKED files never appear — document, don't guess
+        paths.extend(p for p in changed if os.path.exists(p))
+        if not paths:
+            # the --json contract promises a JSON array on stdout even
+            # on this fast path — prose goes to stderr
+            if args.as_json:
+                print("[]")
+            print("lint --changed: no changed python files in the "
+                  "scanned tree (" + ", ".join(DEFAULT_SCAN) + ")",
+                  file=sys.stderr)
+            return 0
     try:
-        findings = run_lint(args.paths or None, select=select, root=root)
+        findings = run_lint(paths or None, select=select, root=root,
+                            stale=not args.no_stale)
     except (ValueError, FileNotFoundError) as e:
         print(e.args[0], file=sys.stderr)
         return 2

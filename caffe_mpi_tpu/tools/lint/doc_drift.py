@@ -30,7 +30,7 @@ import re
 from typing import Iterator
 
 from . import (DEFAULT_SCAN, Finding, LintPass, extract_waivers,
-               iter_py_files, register)
+               iter_py_files, register, span_waiver_lines)
 
 # every FaultPlane entry point a production call site can name a site
 # through (fire/fire_at and the one-line helpers)
@@ -90,6 +90,16 @@ def _stmt_spans(tree: ast.Module | None) -> dict[int, tuple[int, int]]:
     return spans
 
 
+def _waived_at(pass_name: str, ln: int,
+               spans: dict[int, tuple[int, int]],
+               waivers: dict[int, set[str]], lines: list[str]) -> bool:
+    """Self-applied waiver check — delegates to the framework's ONE
+    binding contract (span_waiver_lines), so self-waiving passes can
+    never bind differently from everyone else."""
+    return bool(span_waiver_lines(spans.get(ln, (ln, ln)), pass_name,
+                                  waivers, lines))
+
+
 def _doc_sites(path: str) -> tuple[set[str], int]:
     text = open(path, encoding="utf-8").read()
     m = re.search(r"Sites:\s*(.*?)\.\s", text, re.DOTALL)
@@ -99,11 +109,99 @@ def _doc_sites(path: str) -> tuple[set[str], int]:
     return set(re.findall(r"`([a-z_]+)`", m.group(1))), line
 
 
+# -- exit-code drift (ISSUE 13 satellite) -----------------------------------
+# the EXIT_* registry in utils/resilience.py, the exit-code table in
+# docs/robustness.md, and the literal sys.exit/os._exit call sites are
+# three spellings of one contract: what a dying process MEANS by its
+# exit code. The PR 11 "hard-exiting 86" log rot class is exactly this
+# table drifting from the code that operators debug against.
+
+_EXIT_NAME_RE = re.compile(r"EXIT_[A-Z_]+")
+_EXIT_ROW_RE = re.compile(r"\|\s*\*\*(\d+)\*\*\s*\|([^|]*)\|")
+_EXIT_CALL_HINT = ("sys.exit", "os._exit")
+
+
+def _exit_registry(path: str) -> dict[str, tuple[int, int]]:
+    """{EXIT_NAME: (code, line)} from top-level assigns; aliases
+    (`EXIT_CLUSTER = EXIT_FAULT`) resolve through the map."""
+    try:
+        tree = ast.parse(open(path, encoding="utf-8").read(),
+                         filename=path)
+    except SyntaxError:
+        return {}
+    out: dict[str, tuple[int, int]] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id.startswith("EXIT_"):
+            name, v = node.targets[0].id, node.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                out[name] = (v.value, node.lineno)
+            elif isinstance(v, ast.Name) and v.id in out:
+                out[name] = (out[v.id][0], node.lineno)
+    return out
+
+
+def _doc_exit_table(path: str) -> dict[str, tuple[int, int]]:
+    """{EXIT_NAME: (code, line)} from docs table rows like
+    `| **87** | \\`EXIT_CLUSTER\\` / \\`EXIT_FAULT\\` | ...`."""
+    out: dict[str, tuple[int, int]] = {}
+    for i, line in enumerate(
+            open(path, encoding="utf-8").read().splitlines(), 1):
+        m = _EXIT_ROW_RE.match(line.strip())
+        if m:
+            for name in _EXIT_NAME_RE.findall(m.group(2)):
+                out[name] = (int(m.group(1)), i)
+    return out
+
+
+def _exit_call_violations(tree: ast.Module, exits: dict,
+                          codes: set[int]) -> list[tuple[int, str]]:
+    """(line, message) for each sys.exit/os._exit call whose argument
+    is a bare literal matching a registered code (operators grep for
+    the symbol, not the number) or an EXIT_* symbol the registry no
+    longer defines (a rename that missed a call site)."""
+    out: list[tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and (fn.value.id, fn.attr) in (("sys", "exit"),
+                                               ("os", "_exit"))):
+            continue
+        a = node.args[0]
+        if isinstance(a, ast.Constant) and isinstance(a.value, int) \
+                and a.value in codes:
+            names = sorted(n for n, (c, _l) in exits.items()
+                           if c == a.value)
+            out.append((node.lineno,
+                        f"bare literal exit {a.value} — use the "
+                        f"registered symbol ({' / '.join(names)} in "
+                        "utils/resilience.py) so the code and the "
+                        "operator runbook cannot drift"))
+        else:
+            name = None
+            if isinstance(a, ast.Name):
+                name = a.id
+            elif isinstance(a, ast.Attribute):
+                name = a.attr
+            if name and name.startswith("EXIT_") and name not in exits:
+                out.append((node.lineno,
+                            f"exit call names {name}, which is not in "
+                            "the EXIT_* registry in "
+                            "utils/resilience.py"))
+    return out
+
+
 @register
 class DocDriftPass(LintPass):
     name = "doc-drift"
     description = ("FAULT_SITES registry == docs/robustness.md Sites "
-                   "list == FAULTS call sites")
+                   "list == FAULTS call sites; EXIT_* registry == "
+                   "docs exit-code table == exit call sites")
+    self_waiving = True   # scans files outside the selection itself
 
     def check_tree(self, ctxs: list[FileContext],
                    root: str) -> Iterator[Finding]:
@@ -111,6 +209,7 @@ class DocDriftPass(LintPass):
         docs_path = os.path.join(root, DOCS_FILE)
         if not (os.path.isfile(reg_path) and os.path.isfile(docs_path)):
             return
+        yield from self._exit_findings(ctxs, root, reg_path, docs_path)
         registry, reg_line = _registry_sites(reg_path)
         if not reg_line:
             return
@@ -164,14 +263,10 @@ class DocDriftPass(LintPass):
                     site = m.group(1)
                     ln = src.count("\n", 0, m.start()) + 1
                     # waiver honored across the enclosing statement's
-                    # span, or on a comment-ONLY line directly above
-                    # (same contract as FileContext.waived)
-                    lo, hi = spans.get(ln, (ln, ln))
-                    waived = any(self.name in waivers.get(i, ())
-                                 for i in range(lo, hi + 1))
-                    if not waived and lo > 1 and \
-                            lines[lo - 2].lstrip().startswith("#"):
-                        waived = self.name in waivers.get(lo - 1, ())
+                    # span or the comment block directly above (same
+                    # contract as FileContext.waiver_lines)
+                    waived = _waived_at(self.name, ln, spans, waivers,
+                                        lines)
                     prev = code_sites.get(site)
                     # an unwaived call site outranks a waived one
                     if prev is None or (prev[2] and not waived):
@@ -221,3 +316,74 @@ class DocDriftPass(LintPass):
                     self.name, reg_path, ln,
                     f"FAULT_SITES entry {site!r} has no description",
                     span=None)
+
+    def _exit_findings(self, ctxs: list[FileContext], root: str,
+                       reg_path: str, docs_path: str) -> Iterator[Finding]:
+        """EXIT_* registry vs docs exit-code table vs literal
+        sys.exit/os._exit call sites, three-way. Skips entirely for
+        roots that model no EXIT_ registry (fixture trees)."""
+        exits = _exit_registry(reg_path)
+        if not exits:
+            return
+        codes = {code for code, _ln in exits.values()}
+        table = _doc_exit_table(docs_path)
+        if not table:
+            yield Finding(
+                self.name, docs_path, 1,
+                "docs/robustness.md lost its exit-code table "
+                "(`| **N** | `EXIT_NAME`` rows) while "
+                f"{os.path.basename(reg_path)} registers "
+                f"{sorted(exits)} — operators debug against this table",
+                span=None)
+            return
+        for name, (code, ln) in sorted(exits.items()):
+            doc = table.get(name)
+            if doc is None:
+                yield Finding(
+                    self.name, reg_path, ln,
+                    f"exit code {name} ({code}) is not in the "
+                    "docs/robustness.md exit-code table", span=None)
+            elif doc[0] != code:
+                yield Finding(
+                    self.name, docs_path, doc[1],
+                    f"docs/robustness.md documents {name} as exit "
+                    f"{doc[0]} but the registry says {code}", span=None)
+        for name, (code, ln) in sorted(table.items()):
+            if name not in exits:
+                yield Finding(
+                    self.name, docs_path, ln,
+                    f"docs/robustness.md documents exit code {name} "
+                    f"({code}) that is not registered in "
+                    f"{os.path.basename(reg_path)}", span=None)
+        # call sites: literal exits must use the registered symbols,
+        # and exit symbols must exist in the registry. Same self-applied
+        # waiver contract as the fault-site scan above.
+        by_path = {c.path: c for c in ctxs}
+        for target in SCAN:
+            path = os.path.join(root, target)
+            if not os.path.exists(path):
+                continue
+            for fp in iter_py_files([path]):
+                ctx = by_path.get(os.path.abspath(fp))
+                if ctx is not None:
+                    src, tree, waivers = ctx.src, ctx.tree, ctx.waivers
+                else:
+                    src = open(fp, encoding="utf-8").read()
+                    if not any(h in src for h in _EXIT_CALL_HINT):
+                        continue
+                    waivers = extract_waivers(src)
+                    try:
+                        tree = ast.parse(src)
+                    except SyntaxError:
+                        continue
+                if tree is None or not any(h in src
+                                           for h in _EXIT_CALL_HINT):
+                    continue
+                spans = _stmt_spans(tree)
+                lines = src.splitlines()
+                for viol_line, msg in _exit_call_violations(tree, exits,
+                                                            codes):
+                    if not _waived_at(self.name, viol_line, spans,
+                                      waivers, lines):
+                        yield Finding(self.name, fp, viol_line, msg,
+                                      span=None)

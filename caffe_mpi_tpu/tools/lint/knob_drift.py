@@ -124,6 +124,7 @@ class KnobDriftPass(LintPass):
     description = ("perf knobs (step_chunk/test_chunk/reduce_*) must be "
                    "declared, CLI-flagged, documented, and CONSUMED — "
                    "no accept-and-ignore")
+    self_waiving = True   # applies registry-line waivers itself
 
     def check_tree(self, ctxs: list[FileContext],
                    root: str) -> Iterator[Finding]:

@@ -21,7 +21,8 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 ALL_PASSES = ("host-sync", "traced-control-flow", "concrete-init",
               "gated-imports", "reference-citation", "doc-drift",
-              "knob-drift")
+              "knob-drift", "lock-order", "blocking-under-lock",
+              "thread-shared-mutation")
 
 
 def _write(tmp_path, name, src):
@@ -48,6 +49,9 @@ def test_all_tentpole_passes_registered():
     for name in ALL_PASSES:
         assert name in lint.REGISTRY, name
         assert lint.REGISTRY[name].description
+    # the documented suite size (CLAUDE.md / docs/static_analysis.md):
+    # exactly ten passes, nothing registered twice or forgotten
+    assert len(lint.REGISTRY) == 10, sorted(lint.REGISTRY)
 
 
 def test_shipped_tree_is_clean_fast_and_jax_free():
@@ -783,3 +787,650 @@ def test_multi_pass_waiver(tmp_path):
             return out
     """)
     assert _run([p], ["host-sync", "traced-control-flow"]) == []
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock (ISSUE 13): the PR 7 / PR 11 regression shapes
+
+_PR7_SET_RESULT_UNDER_REC_LOCK = """
+    import threading
+
+    class Batcher:
+        def __init__(self):
+            self._rec_lock = threading.Lock()
+            self._records = []
+
+        def harvest(self, group, scores):
+            with self._rec_lock:
+                self._records.append(len(group))
+                for i, r in enumerate(group):
+                    r.future.set_result(scores[i])
+"""
+
+_PR11_UPLOAD_UNDER_UPLOAD_LOCK = """
+    import threading
+
+    class InferenceModel:
+        def __init__(self):
+            self._upload_lock = threading.Lock()
+            self._resident = None
+
+        def ensure_resident(self, host):
+            import jax
+            with self._upload_lock:
+                if self._resident is None:
+                    self._resident = jax.device_put(host)
+                return self._resident
+"""
+
+
+def test_blocking_catches_pr7_set_result_under_rec_lock(tmp_path):
+    """The PR 7 second-round deadlock shape: a Future resolved under
+    the non-reentrant records lock (done-callbacks run synchronously
+    in the resolving thread)."""
+    p = _write(tmp_path, "b.py", _PR7_SET_RESULT_UNDER_REC_LOCK)
+    findings = _run([p], ["blocking-under-lock"], root=str(tmp_path))
+    assert len(findings) == 1
+    assert "Future.set_result" in findings[0].message
+    assert "_rec_lock" in findings[0].message
+
+
+def test_blocking_catches_pr11_upload_under_upload_lock(tmp_path):
+    """The PR 11 shape: a tunnel-length device upload inside a held
+    lock span."""
+    p = _write(tmp_path, "m.py", _PR11_UPLOAD_UNDER_UPLOAD_LOCK)
+    findings = _run([p], ["blocking-under-lock"], root=str(tmp_path))
+    assert len(findings) == 1
+    assert "jax.device_put" in findings[0].message
+    assert "_upload_lock" in findings[0].message
+
+
+def test_blocking_honors_waiver(tmp_path):
+    p = _write(tmp_path, "w.py", """
+        import threading
+
+        class InferenceModel:
+            def __init__(self):
+                self._upload_lock = threading.Lock()
+
+            def ensure_resident(self, host):
+                import jax
+                with self._upload_lock:
+                    # lint: ok(blocking-under-lock) — upload serialization
+                    # is this lock's purpose; no other lock is held here
+                    return jax.device_put(host)
+    """)
+    assert _run([p], ["blocking-under-lock"], root=str(tmp_path)) == []
+
+
+def test_blocking_flags_unbounded_waits_but_not_condition_wait(tmp_path):
+    """queue.get()/join()/result() with no timeout block forever under
+    a lock; a Condition's own .wait() under its lock is the sanctioned
+    pattern (it RELEASES the lock) and must not be flagged."""
+    p = _write(tmp_path, "u.py", """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._q = None
+                self._t = None
+
+            def run_ok(self):
+                with self._cv:
+                    while self._q is None:
+                        self._cv.wait()          # sanctioned
+
+            def run_bad(self, fut):
+                with self._cv:
+                    item = self._q.get()         # unbounded
+                    self._t.join()               # unbounded
+                    return fut.result(), item    # unbounded
+    """)
+    findings = _run([p], ["blocking-under-lock"], root=str(tmp_path))
+    kinds = sorted(f.message.split(" inside")[0] for f in findings)
+    assert kinds == [".get() without timeout", ".join() without timeout",
+                     ".result() without timeout"]
+
+
+def test_blocking_outside_lock_is_clean(tmp_path):
+    """The fixed shapes — snapshot under the lock, resolve outside —
+    must be clean (the diff that fixed PR 7 has to lint clean)."""
+    p = _write(tmp_path, "ok.py", """
+        import threading
+
+        class Batcher:
+            def __init__(self):
+                self._rec_lock = threading.Lock()
+                self._records = []
+
+            def harvest(self, group, scores):
+                with self._rec_lock:
+                    self._records.append(len(group))
+                for i, r in enumerate(group):
+                    r.future.set_result(scores[i])
+    """)
+    assert _run([p], ["blocking-under-lock"], root=str(tmp_path)) == []
+
+
+# ---------------------------------------------------------------------------
+# lock-order (ISSUE 13): nesting vs the declared LOCK_ORDER
+
+_TWO_LOCK_CLASSES = """
+    import threading
+
+    class InferenceModel:
+        def __init__(self):
+            self._upload_lock = threading.Lock()
+
+    class ServingEngine:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def swap(self, model):
+            with model._upload_lock:
+                with self._lock:
+                    pass
+"""
+
+
+def _lock_registry(tmp_path, body):
+    return _write(tmp_path, "caffe_mpi_tpu/serving/locks.py", body)
+
+
+def test_lock_order_undeclared_nesting_is_a_finding(tmp_path):
+    p = _write(tmp_path, "eng.py", _TWO_LOCK_CLASSES)
+    findings = _run([p], ["lock-order"], root=str(tmp_path))
+    assert len(findings) == 1
+    assert "undeclared lock nesting" in findings[0].message
+    assert "InferenceModel._upload_lock" in findings[0].message
+
+
+def test_lock_order_declared_nesting_is_clean(tmp_path):
+    _lock_registry(tmp_path, """
+        LOCK_ORDER = (
+            ("InferenceModel._upload_lock", "ServingEngine._lock"),
+        )
+    """)
+    p = _write(tmp_path, "eng.py", _TWO_LOCK_CLASSES)
+    assert _run([p], ["lock-order"], root=str(tmp_path)) == []
+
+
+def test_lock_order_catches_inverted_upload_engine_nesting(tmp_path):
+    """The acceptance shape: LOCK_ORDER declares _upload_lock ->
+    engine._lock; code that nests engine._lock -> _upload_lock is the
+    PR 11 deadlock inversion and must fail LOUDLY."""
+    _lock_registry(tmp_path, """
+        LOCK_ORDER = (
+            ("InferenceModel._upload_lock", "ServingEngine._lock"),
+        )
+    """)
+    p = _write(tmp_path, "eng.py", """
+        import threading
+
+        class InferenceModel:
+            def __init__(self):
+                self._upload_lock = threading.Lock()
+
+        class ServingEngine:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad_swap(self, model):
+                with self._lock:
+                    with model._upload_lock:
+                        pass
+    """)
+    findings = _run([p], ["lock-order"], root=str(tmp_path))
+    assert len(findings) == 1
+    assert "INVERTED" in findings[0].message
+
+
+def test_lock_order_sees_nesting_through_resolvable_calls(tmp_path):
+    """Holding lock A while CALLING a method that acquires lock B is
+    the same nesting as a syntactic with-in-with — the PR 7 dispatcher
+    shape (engine.model under the batcher's condition variable)."""
+    p = _write(tmp_path, "call.py", """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def model(self, name):
+                with self._lock:
+                    return name
+
+        class Batcher:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._engine = Engine()
+
+            def dispatch(self, name):
+                with self._cv:
+                    return self._engine.model(name)
+    """)
+    findings = _run([p], ["lock-order"], root=str(tmp_path))
+    assert len(findings) == 1
+    assert "Batcher._cv" in findings[0].message
+    assert "Engine._lock" in findings[0].message
+    assert "call to Engine.model" in findings[0].message
+
+
+def test_lock_order_reacquire_nonreentrant_flagged_rlock_clean(tmp_path):
+    p = _write(tmp_path, "re.py", """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._rlock = threading.RLock()
+
+            def bad(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+
+            def fine(self):
+                with self._rlock:
+                    with self._rlock:
+                        pass
+    """)
+    findings = _run([p], ["lock-order"], root=str(tmp_path))
+    assert len(findings) == 1
+    assert "self-deadlock" in findings[0].message
+
+
+def test_lock_order_registry_drift_unknown_lock_fails(tmp_path):
+    """A LOCK_ORDER entry naming a lock that no longer exists in the
+    tree is itself a finding — the registry cannot outlive the code
+    (the acceptance's seeded-mismatch case)."""
+    _lock_registry(tmp_path, """
+        LOCK_ORDER = (
+            ("Ghost._lock", "AlsoGhost._lock"),
+        )
+    """)
+    p = _write(tmp_path, "code.py", """
+        def f():
+            return 1
+    """)
+    findings = _run([p], ["lock-order"], root=str(tmp_path))
+    msgs = "\\n".join(f.message for f in findings)
+    assert "unknown lock 'Ghost._lock'" in msgs
+    assert "unknown lock 'AlsoGhost._lock'" in msgs
+
+
+def test_lock_order_registry_cycle_fails(tmp_path):
+    _lock_registry(tmp_path, """
+        LOCK_ORDER = (
+            ("A._lock", "B._lock"),
+            ("B._lock", "A._lock"),
+        )
+    """)
+    p = _write(tmp_path, "code.py", """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+    """)
+    findings = _run([p], ["lock-order"], root=str(tmp_path))
+    assert any("cycle" in f.message for f in findings)
+
+
+def test_lock_order_honors_waiver(tmp_path):
+    p = _write(tmp_path, "w.py", """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._outer = threading.Lock()
+                self._inner = threading.Lock()
+
+            def f(self):
+                with self._outer:
+                    # lint: ok(lock-order) — fixture: deliberate nesting
+                    with self._inner:
+                        pass
+    """)
+    assert _run([p], ["lock-order"], root=str(tmp_path)) == []
+
+
+def test_shipped_lock_order_registry_matches_tree():
+    """The real registry drift-holds against the real tree: every
+    LOCK_ORDER node and ATTR_TYPES entry must resolve (a rename that
+    misses serving/locks.py fails here and in the CLI)."""
+    findings = _run([], ["lock-order"], root=_ROOT)
+    assert findings == [], [f.format(_ROOT) for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# thread-shared-mutation (ISSUE 13)
+
+def test_thread_shared_mutation_catches_seeded_race(tmp_path):
+    p = _write(tmp_path, "race.py", """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._count = 0
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                self._count += 1
+
+            def bump(self):
+                with self._lock:
+                    self._count += 1
+    """)
+    findings = _run([p], ["thread-shared-mutation"], root=str(tmp_path))
+    assert len(findings) == 1
+    assert "self._count" in findings[0].message
+    assert "Worker._run" in findings[0].message
+
+
+def test_thread_shared_mutation_both_locked_is_clean(tmp_path):
+    p = _write(tmp_path, "ok.py", """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._count = 0
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                with self._lock:
+                    self._count += 1
+
+            def bump(self):
+                with self._lock:
+                    self._count += 1
+    """)
+    assert _run([p], ["thread-shared-mutation"],
+                root=str(tmp_path)) == []
+
+
+def test_thread_shared_mutation_honors_waiver_and_init_exempt(tmp_path):
+    """__init__ mutations don't count (no thread exists yet), and the
+    waiver-with-reason contract holds — PER SITE: every unlocked racy
+    mutation site is its own finding, so each carries its own waiver
+    (one waived anchor must not silence a race added elsewhere)."""
+    p = _write(tmp_path, "w.py", """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._state = 0     # pre-thread: exempt
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                # lint: ok(thread-shared-mutation) — reset() is only
+                # called after join() in this fixture's lifecycle
+                self._state = 1
+
+            def reset(self):
+                # lint: ok(thread-shared-mutation) — only called after
+                # join(), same lifecycle contract as _run above
+                self._state = 0
+    """)
+    assert _run([p], ["thread-shared-mutation"],
+                root=str(tmp_path)) == []
+
+
+def test_thread_shared_mutation_reports_every_unlocked_site(tmp_path):
+    """A waiver on one racy site must not silence a DIFFERENT unlocked
+    site of the same attribute — each gets its own finding."""
+    p = _write(tmp_path, "two.py", """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                # lint: ok(thread-shared-mutation) — fixture: waived site
+                self._state = 1
+
+            def reset(self):
+                self._state = 0
+    """)
+    findings = _run([p], ["thread-shared-mutation"], root=str(tmp_path))
+    assert len(findings) == 1
+    assert "reset" in findings[0].message
+
+
+def test_thread_shared_mutation_pool_submit_is_an_entry(tmp_path):
+    """A ThreadPoolExecutor.submit callee is a thread body too (the
+    feeder's pool workers)."""
+    p = _write(tmp_path, "pool.py", """
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Feeder:
+            def __init__(self):
+                self.pool = ThreadPoolExecutor(2)
+                self._mode = None
+
+            def schedule(self, it):
+                return self.pool.submit(self._build, it)
+
+            def _build(self, it):
+                self._mode = "fused"
+                return it
+
+            def retune(self):
+                self._mode = "classic"
+    """)
+    findings = _run([p], ["thread-shared-mutation"], root=str(tmp_path))
+    # per-site reporting: the pool-worker write AND the public write
+    # are each their own finding
+    assert len(findings) == 2
+    assert all("self._mode" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# exit-code drift (ISSUE 13 satellite, folded into doc-drift)
+
+def _exit_tree(tmp_path, *, doc_code=86, call="os._exit(EXIT_WATCHDOG)"):
+    _write(tmp_path, "caffe_mpi_tpu/utils/resilience.py", f"""
+        import os
+        EXIT_WATCHDOG = 86
+        EXIT_FAULT = 87
+        EXIT_CLUSTER = EXIT_FAULT
+
+        def die():
+            {call}
+    """)
+    _write(tmp_path, "docs/robustness.md", f"""
+        Exit codes:
+
+        | code | name | meaning |
+        |---|---|---|
+        | **{doc_code}** | `EXIT_WATCHDOG` | watchdog trip |
+        | **87** | `EXIT_CLUSTER` / `EXIT_FAULT` | cluster loss |
+    """)
+    return str(tmp_path)
+
+
+def test_exit_drift_clean_tree_is_clean(tmp_path):
+    root = _exit_tree(tmp_path)
+    assert _run([], ["doc-drift"], root=root) == []
+
+
+def test_exit_drift_docs_code_mismatch_fails(tmp_path):
+    """The PR 11 rot class: the docs table claiming a different number
+    than the registry sends operators hunting a death that never
+    happened."""
+    root = _exit_tree(tmp_path, doc_code=96)
+    findings = _run([], ["doc-drift"], root=root)
+    msgs = "\\n".join(f.message for f in findings)
+    assert "EXIT_WATCHDOG" in msgs and "96" in msgs
+
+
+def test_exit_drift_bare_literal_exit_fails(tmp_path):
+    root = _exit_tree(tmp_path, call="os._exit(86)")
+    findings = _run([], ["doc-drift"], root=root)
+    assert len(findings) == 1
+    assert "bare literal exit 86" in findings[0].message
+    assert "EXIT_WATCHDOG" in findings[0].message
+
+
+def test_exit_drift_unregistered_symbol_fails(tmp_path):
+    root = _exit_tree(tmp_path, call="os._exit(EXIT_BOGUS)")
+    findings = _run([], ["doc-drift"], root=root)
+    assert len(findings) == 1
+    assert "EXIT_BOGUS" in findings[0].message
+
+
+def test_exit_drift_missing_docs_entry_fails(tmp_path):
+    root = _exit_tree(tmp_path)
+    docs = os.path.join(root, "docs/robustness.md")
+    src = open(docs).read().replace(
+        "| **87** | `EXIT_CLUSTER` / `EXIT_FAULT` | cluster loss |", "")
+    open(docs, "w").write(src)
+    findings = _run([], ["doc-drift"], root=root)
+    msgs = "\\n".join(f.message for f in findings)
+    assert "EXIT_FAULT" in msgs and "EXIT_CLUSTER" in msgs
+
+
+def test_exit_drift_bare_literal_waivable(tmp_path):
+    root = _exit_tree(
+        tmp_path,
+        call="os._exit(86)  # lint: ok(doc-drift) — pre-registry shim")
+    assert _run([], ["doc-drift"], root=root) == []
+
+
+def test_exit_drift_waiver_in_comment_block_above_binds(tmp_path):
+    """The documented contiguous-comment-block binding holds for the
+    self-applied exit-call waivers too — a multi-line reason must not
+    detach the waiver from its statement."""
+    _write(tmp_path, "caffe_mpi_tpu/utils/resilience.py", """
+        import os
+        EXIT_WATCHDOG = 86
+
+        def die():
+            # lint: ok(doc-drift) — pre-registry shim kept for one
+            # release so old supervisors keep matching on the number
+            os._exit(86)
+    """)
+    _write(tmp_path, "docs/robustness.md", """
+        | **86** | `EXIT_WATCHDOG` | watchdog trip |
+    """)
+    assert _run([], ["doc-drift"], root=str(tmp_path)) == []
+
+
+# ---------------------------------------------------------------------------
+# stale-waiver detection (ISSUE 13 satellite)
+
+def test_stale_waiver_reported_when_pass_no_longer_fires(tmp_path):
+    p = _write(tmp_path, "stale.py", """
+        import numpy as np
+
+        def f(x):
+            # not in a loop: host-sync has nothing to say here
+            return float(x)  # lint: ok(host-sync) — display boundary
+    """)
+    findings = lint.run_lint([p], select=["host-sync"],
+                             root=str(tmp_path), stale=True)
+    assert len(findings) == 1
+    assert findings[0].pass_name == "stale-waiver"
+    assert "host-sync" in findings[0].message
+
+
+def test_stale_waiver_not_reported_for_honored_waiver(tmp_path):
+    p = _write(tmp_path, "honored.py", """
+        def f(xs):
+            out = []
+            for x in xs:
+                out.append(float(x))  # lint: ok(host-sync) — fixture
+            return out
+    """)
+    assert lint.run_lint([p], select=["host-sync"],
+                         root=str(tmp_path), stale=True) == []
+
+
+def test_stale_waiver_off_by_default_in_library_api(tmp_path):
+    p = _write(tmp_path, "stale.py", """
+        def f(x):
+            return float(x)  # lint: ok(host-sync) — fixture
+    """)
+    assert lint.run_lint([p], select=["host-sync"],
+                         root=str(tmp_path)) == []
+
+
+def test_stale_waiver_only_judges_selected_passes(tmp_path):
+    """A --select run must not call waivers for UNSELECTED passes
+    stale — those passes never got the chance to fire."""
+    p = _write(tmp_path, "other.py", """
+        def f(x):
+            return float(x)  # lint: ok(host-sync) — fixture
+    """)
+    assert lint.run_lint([p], select=["gated-imports"],
+                         root=str(tmp_path), stale=True) == []
+
+
+def test_stale_waiver_multiline_comment_block_binds_to_statement(tmp_path):
+    """A waiver anywhere in the contiguous comment block directly above
+    the statement is honored (multi-line reasons are encouraged, not
+    punished)."""
+    p = _write(tmp_path, "block.py", """
+        def f(xs):
+            out = []
+            for x in xs:
+                # lint: ok(host-sync) — the reason here is long enough
+                # to need a second comment line, which must not detach
+                # the waiver from its statement
+                out.append(float(x))
+            return out
+    """)
+    assert lint.run_lint([p], select=["host-sync"],
+                         root=str(tmp_path), stale=True) == []
+
+
+# ---------------------------------------------------------------------------
+# --changed CLI mode (ISSUE 13 satellite)
+
+def test_changed_mode_typod_ref_is_usage_error():
+    """A typo'd git ref must exit 2 (usage error), NEVER a false-clean
+    exit 0 with zero files scanned."""
+    assert lint.main(["--changed", "no-such-ref-xyz"]) == 2
+
+
+def test_changed_mode_valid_ref_is_not_a_usage_error():
+    assert lint.main(["--changed", "HEAD", "--no-stale"]) != 2
+
+
+def test_changed_mode_explicit_paths_still_lint(tmp_path):
+    bad = _write(tmp_path, "bad.py", """
+        def f(xs):
+            return [float(x) for x in xs]
+    """)
+    assert lint.main(["--changed", "HEAD", "--select", "host-sync",
+                      "--no-stale", bad]) == 1
+
+
+def test_changed_mode_skips_files_outside_the_scanned_tree(monkeypatch):
+    """tests/ and examples/ are deliberately outside the lint contract
+    (torch-oracle host syncs etc.) — a commit touching only such files
+    must not fail the pre-commit run on code the full scan exempts."""
+    import subprocess
+
+    real_run = subprocess.run
+
+    def fake_run(cmd, **kw):
+        if cmd[:3] == ["git", "diff", "--name-only"]:
+            class R:
+                returncode = 0
+                stdout = "tests/test_multistep.py\nexamples/mnist/run.py\n"
+                stderr = ""
+            return R()
+        return real_run(cmd, **kw)
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    assert lint.main(["--changed", "HEAD", "--select", "host-sync",
+                      "--no-stale"]) == 0

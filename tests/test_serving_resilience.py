@@ -253,6 +253,20 @@ class TestStallBreaker:
         assert not wd._thread.is_alive()
         assert eng._watchdog is None
 
+    def test_probe_recovery_after_close_does_not_rearm(self, tmp_path):
+        # a recovery-probe thread that loses the race with close() must
+        # not re-arm a fresh watchdog (a monitor thread nobody would
+        # ever stop) or flip a closed engine back to healthy
+        model, weights = write_toy(tmp_path)
+        eng = ServingEngine(window_ms=0, stall_s=5.0)
+        eng.load_model("m", model, weights)
+        eng._on_stall("dispatch:m", 9.9)   # breaker open
+        assert not eng.healthy
+        eng.close()
+        assert eng.probe_recovery(timeout=5) is False
+        assert eng._watchdog is None
+        assert not eng.healthy
+
     def test_breaker_off_by_default(self, tmp_path):
         model, weights = write_toy(tmp_path)
         with ServingEngine(window_ms=0) as eng:

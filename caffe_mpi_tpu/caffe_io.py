@@ -35,14 +35,24 @@ def datum_to_array(buf: bytes) -> tuple[np.ndarray, int]:
 # -- images -----------------------------------------------------------------
 
 def load_image(filename: str, color: bool = True) -> np.ndarray:
-    """Load as float [0,1] HWC RGB (reference io.py load_image semantics)."""
-    from PIL import Image
-    img = Image.open(filename)
-    img = img.convert("RGB" if color else "L")
-    arr = np.asarray(img, np.float32) / 255.0
-    if not color:
-        arr = arr[:, :, None]
-    return arr
+    """Load as float [0,1] HWC RGB (reference io.py load_image semantics).
+
+    ISSUE 14: color loads route through the native decode plane
+    (data/decode.py — the same policy, counters and PIL fallback the
+    training feeder and the serving request path use), so the
+    Classifier/Detector file surface decodes in C too. PNG stays
+    bitwise-identical to the PIL path; JPEG is within 1 LSB per pixel
+    pre-/255 (the decode plane's documented contract). Grayscale keeps
+    PIL (the "L" luma weights live there)."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    from .data import decode as _decode
+    if color:
+        # decode_file: native when enabled/decodable, PIL otherwise —
+        # (3, h, w) planar BGR uint8 either way
+        return _decode.to_float_image(_decode.decode_file(data))
+    arr = _decode.decode_file(data, is_color=False)
+    return arr[0, :, :, None].astype(np.float32) / 255.0
 
 
 def resize_image(im: np.ndarray, new_dims, interp_order: int = 1) -> np.ndarray:

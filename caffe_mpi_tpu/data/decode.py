@@ -130,6 +130,18 @@ def decode_image(data: bytes) -> np.ndarray:
     return _pil_decode(data)
 
 
+def to_float_image(arr: np.ndarray) -> np.ndarray:
+    """(3, h, w) planar BGR uint8 (this plane's pixel contract) -> HWC
+    RGB float32 in [0,1] — the pycaffe load_image / web-upload
+    convention. Bitwise what PIL's own decode-and-convert would produce
+    for the same pixels (u8 -> f32 is exact, /255.0 is one IEEE divide),
+    so callers can decode natively and still feed the classic float
+    surfaces (ISSUE 14's serving fallback path, caffe_io.load_image)."""
+    if arr.ndim != 3 or arr.shape[0] != 3:
+        raise ValueError(f"expected (3, h, w) BGR uint8, got {arr.shape}")
+    return arr[::-1].transpose(1, 2, 0).astype(np.float32) / 255.0
+
+
 def decode_file(data: bytes, *, is_color: bool = True, new_h: int = 0,
                 new_w: int = 0) -> np.ndarray:
     """File-read image bytes -> CHW uint8, with the ImageData layer's

@@ -96,6 +96,27 @@ def _load():
         ]
     except AttributeError:
         pass
+    # serving request preprocess (ISSUE 14); a pre-existing .so without
+    # the symbol still loads (per-request Python preprocess is the
+    # fallback)
+    try:
+        lib.caffe_tpu_serve_preprocess_batch.restype = ctypes.c_int
+        lib.caffe_tpu_serve_preprocess_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p),          # srcs
+            ctypes.POINTER(ctypes.c_int32),           # dims (h, w pairs)
+            ctypes.c_int, ctypes.c_int,               # n, channels
+            ctypes.c_int, ctypes.c_int,               # img_h, img_w
+            ctypes.c_int, ctypes.c_int,               # crop_h, crop_w
+            ctypes.POINTER(ctypes.c_int32),           # swap
+            ctypes.c_int, ctypes.c_float,             # has_raw, raw_scale
+            ctypes.POINTER(ctypes.c_float),           # mean (nullable)
+            ctypes.c_int, ctypes.c_float,             # has_iscale, scale
+            ctypes.POINTER(ctypes.c_float),           # out
+            ctypes.POINTER(ctypes.c_int32),           # status
+            ctypes.c_int,                             # num_threads
+        ]
+    except AttributeError:
+        pass
     lib.caffe_tpu_transform_batch.restype = ctypes.c_int
     lib.caffe_tpu_transform_batch.argtypes = [
         ctypes.POINTER(ctypes.c_void_p),          # srcs
@@ -348,6 +369,70 @@ def decode_resize_native(data: bytes, out_h: int,
     if rc != DECODE_OK:
         raise RuntimeError(f"native decode+resize failed with code {rc}")
     return out
+
+
+def serve_preprocess_available() -> bool:
+    """True when the loaded .so carries the serving window-preprocess
+    entry (ISSUE 14). Independent of the codecs: the entry transforms
+    already-decoded arrays, so a transform-only build still has it."""
+    lib = _load()
+    return lib is not None and hasattr(lib,
+                                       "caffe_tpu_serve_preprocess_batch")
+
+
+def serve_preprocess_batch(raws, *, img_h: int, img_w: int, crop_h: int,
+                           crop_w: int, swap, raw_scale: float | None = None,
+                           mean=None, input_scale: float | None = None,
+                           num_threads: int = 4):
+    """Window-fused serving preprocess: `raws` is a list of (c, h, w)
+    uint8 contiguous planar images (dims may vary per record). Returns
+    (out, status): out (n, c, crop_h, crop_w) float32 — each row the
+    bitwise Python per-request chain for the same decoded pixels —
+    and the (n,) int32 per-record status (0 ok; nonzero rows are
+    untouched, the caller preprocesses those records in Python)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "caffe_tpu_serve_preprocess_batch"):
+        raise RuntimeError("native serve preprocess unavailable; rebuild "
+                           "with caffe_mpi_tpu/native/build.sh")
+    n = len(raws)
+    if n == 0:
+        raise ValueError("empty preprocess batch")
+    c = int(raws[0].shape[0])
+    dims = np.empty(2 * n, np.int32)
+    src_ptrs = (ctypes.c_void_p * n)()
+    for i, a in enumerate(raws):
+        if a.dtype != np.uint8 or a.ndim != 3 or not a.flags.c_contiguous \
+                or a.shape[0] != c:
+            raise ValueError(f"record {i}: expected contiguous ({c}, h, w) "
+                             f"uint8, got {a.dtype} {a.shape}")
+        dims[2 * i], dims[2 * i + 1] = a.shape[1], a.shape[2]
+        src_ptrs[i] = a.ctypes.data
+    swap = np.ascontiguousarray(swap, np.int32)
+    if swap.size != c:
+        raise ValueError(f"swap must name {c} source planes")
+    mean_ptr = None
+    if mean is not None:
+        mean = np.ascontiguousarray(mean, np.float32).reshape(-1)
+        if mean.size != c:
+            raise ValueError("serving fused preprocess needs a per-channel "
+                             "mean")
+        mean_ptr = mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+    out = np.empty((n, c, crop_h, crop_w), np.float32)
+    status = np.empty(n, np.int32)
+    rc = lib.caffe_tpu_serve_preprocess_batch(
+        src_ptrs, dims.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        n, c, img_h, img_w, crop_h, crop_w,
+        swap.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        int(raw_scale is not None),
+        float(raw_scale) if raw_scale is not None else 0.0,
+        mean_ptr,
+        int(input_scale is not None),
+        float(input_scale) if input_scale is not None else 0.0,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        status.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), num_threads)
+    if rc != 0:
+        raise RuntimeError(f"native serve preprocess rejected (code {rc})")
+    return out, status
 
 
 def decode_transform_batch(bufs: list[bytes], record_ids, *,

@@ -510,3 +510,61 @@ int caffe_tpu_decode_transform_batch(const uint8_t* const*, const int64_t*,
 }  // extern "C"
 
 #endif  // CAFFE_TPU_NO_CODEC
+
+// ---------------------------------------------------------------------------
+// Serving request preprocess (ISSUE 14) — OUTSIDE the codec gate: it
+// operates on already-decoded arrays (native- or PIL-decoded alike), so
+// a transform-only build still fuses the serving window's preprocessing.
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+// Window-fused serving preprocess: n pre-decoded planar-CHW uint8 images
+// (per-record dims in `dims` as (h, w) pairs; channel storage order is
+// the caller's — `swap` composes it with the Transformer channel_swap)
+// -> n f32 rows of (channels, crop_h, crop_w), each the BITWISE result
+// of the Python per-request chain (transform_core.h serve_preprocess_one:
+// u8/255 -> PIL-convention resize to (img_h, img_w) -> center crop ->
+// * raw_scale - mean[ch] * input_scale). Threaded across records, GIL
+// released for the whole window. `status` is per-record (0 ok, nonzero
+// geometry/argument trouble — the caller re-runs those records through
+// the Python fallback). Returns nonzero only for argument errors.
+int caffe_tpu_serve_preprocess_batch(
+    const uint8_t* const* srcs, const int32_t* dims, int n, int channels,
+    int img_h, int img_w, int crop_h, int crop_w, const int32_t* swap,
+    int has_raw, float raw_scale, const float* mean, int has_iscale,
+    float input_scale, float* out, int32_t* status, int num_threads) {
+  if (srcs == nullptr || dims == nullptr || swap == nullptr ||
+      out == nullptr || status == nullptr || n <= 0 || channels <= 0 ||
+      img_h <= 0 || img_w <= 0 || crop_h <= 0 || crop_w <= 0)
+    return 1;
+  const int64_t row = (int64_t)channels * crop_h * crop_w;
+  auto preprocess_range = [&](int begin, int end) {
+    for (int i = begin; i < end; ++i) {
+      status[i] = (int32_t)caffe_tpu::serve_preprocess_one(
+          srcs[i], channels, (int)dims[2 * i], (int)dims[2 * i + 1], img_h,
+          img_w, crop_h, crop_w, swap, has_raw, raw_scale, mean, has_iscale,
+          input_scale, out + i * row);
+    }
+  };
+  if (num_threads <= 1 || n == 1) {
+    preprocess_range(0, n);
+    return 0;
+  }
+  int nt = num_threads < n ? num_threads : n;
+  std::vector<std::thread> threads;
+  threads.reserve(nt);
+  int chunk = (n + nt - 1) / nt;
+  for (int t = 0; t < nt; ++t) {
+    int begin = t * chunk;
+    int end = begin + chunk < n ? begin + chunk : n;
+    if (begin >= end) break;
+    threads.emplace_back([&preprocess_range, begin, end] {
+      preprocess_range(begin, end);
+    });
+  }
+  for (auto& th : threads) th.join();
+  return 0;
+}
+
+}  // extern "C"

@@ -1154,6 +1154,19 @@ class ServingParameter(Message):
     # requests shed immediately (HTTP 503) instead of hanging; a
     # recovery probe re-arms it. 0 (default) = breaker off.
     serve_stall_s: float = 0.0
+    # hot-content decoded-request cache budget in MiB (ISSUE 14, native
+    # serving ingest — docs/serving.md "Native request ingest"): > 0
+    # keeps decoded request images in RAM keyed by the crc32c of their
+    # ENCODED bytes (LRU by content hash — the same hot image arrives
+    # under many requests; hits are exact-bytes-verified, so a 32-bit
+    # crc collision decodes fresh instead of serving another image's
+    # pixels), so repeats skip JPEG/PNG decode entirely
+    # (`decode_calls` provably unmoved; counters in engine.stats()
+    # /stats). The `decoded_cache_mb` solver knob's machinery applied
+    # request-side. 0 (default) = cache off. The companion env
+    # CAFFE_NATIVE_DECODE=0/1 forces the PIL/native request decoder for
+    # A/B runs, exactly as on the training ingest path.
+    serve_decoded_cache_mb: float = 0.0
 
 
 SOLVER_TYPE_NAMES = {

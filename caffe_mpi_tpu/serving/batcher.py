@@ -48,6 +48,11 @@ class _Request:
     data: np.ndarray
     t_enqueue: float
     future: Future = field(default_factory=Future)
+    # ISSUE 14: True while `data` is a raw DECODED image ((3, h, w) BGR
+    # uint8) whose preprocessing is deferred to the window close — the
+    # dispatcher materializes the net input row (one fused native call
+    # per window) before stacking the batch
+    raw: bool = False
 
 
 class Batcher:
@@ -180,8 +185,9 @@ class Batcher:
                 log.warning("serving: respawned dead %s thread", name)
 
     # -- submission -----------------------------------------------------
-    def submit(self, model: str, data: np.ndarray) -> Future:
-        req = _Request(model, data, time.perf_counter())
+    def submit(self, model: str, data: np.ndarray,
+               raw_mode: bool = False) -> Future:
+        req = _Request(model, data, time.perf_counter(), raw=raw_mode)
         with self._cv:
             if self._stop or self._draining:
                 raise EngineClosedError("serving engine is closed")
@@ -405,8 +411,38 @@ class Batcher:
         for start in range(0, len(group), maxb):
             self._dispatch_one(model, group[start:start + maxb])
 
+    def _materialize(self, model, group: list[_Request]) -> list[_Request]:
+        """Window-fused preprocessing (ISSUE 14): deferred raw-decoded
+        requests become net input rows HERE, at window granularity — one
+        GIL-released native call for the whole group, per-record Python
+        fallback for declines (serving/ingest.py). Runs OUTSIDE every
+        batcher/engine lock, so handler threads keep submitting and the
+        previous batch's device RTT overlaps this window's preprocess.
+        A record whose preprocessing fails fails only its OWN future."""
+        idx = [i for i, r in enumerate(group) if r.raw]
+        if not idx:
+            return group
+        from . import ingest as _ingest
+        rows, errs = _ingest.preprocess_rows(
+            model, [group[i].data for i in idx], self._engine.ingest)
+        dead = set()
+        for j, i in enumerate(idx):
+            if errs[j] is not None:
+                self._resolve(group[i].future, exc=errs[j])
+                self._retire(1)
+                dead.add(i)
+            else:
+                group[i].data = rows[j]
+                group[i].raw = False
+        if not dead:
+            return group
+        return [r for i, r in enumerate(group) if i not in dead]
+
     def _dispatch_one(self, model, group: list[_Request]) -> None:
         from .engine import bucket_for
+        group = self._materialize(model, group)
+        if not group:
+            return
         name = group[0].model
         t0 = time.perf_counter()
         noted = False

@@ -391,6 +391,11 @@ class InferenceModel:
             transpose=(2, 0, 1) if len(in_shape) == 4 else None,
             mean=mean, input_scale=input_scale, raw_scale=raw_scale,
             channel_swap=channel_swap)
+        # native window-preprocess spec (ISSUE 14, serving/ingest.py):
+        # None when this model's preprocessing is not expressible in the
+        # fused kernel — its requests keep the classic per-request path
+        from . import ingest as _ingest
+        self.ingest_plan = _ingest.build_plan(self)
 
     # -- residency ------------------------------------------------------
     @property
@@ -459,6 +464,7 @@ class ServingEngine:
                  buckets=None, queue_limit: int | None = None,
                  deadline_ms: float | None = None,
                  stall_s: float | None = None, journal: str | None = None,
+                 decoded_cache_mb: float | None = None,
                  start: bool = True):
         # AOT warms go through the persistent XLA cache: a restarted
         # server re-loads its zoo from disk hits, not fresh compiles
@@ -498,6 +504,16 @@ class ServingEngine:
             raise ValueError(
                 f"serve_stall_s must be >= 0 (0 = breaker off), "
                 f"got {self.stall_s}")
+        # request-ingest plane (ISSUE 14): native decode + window-fused
+        # preprocessing + the crc32c-keyed hot-content decoded cache
+        cache_mb = float(decoded_cache_mb if decoded_cache_mb is not None
+                         else sp.serve_decoded_cache_mb)
+        if cache_mb < 0:
+            raise ValueError(
+                f"serve_decoded_cache_mb must be >= 0 (0 = cache off), "
+                f"got {cache_mb}")
+        from .ingest import RequestIngest
+        self.ingest = RequestIngest(cache_mb)
         self.journal_prefix = journal
         self.ladder_spec = buckets if buckets is not None \
             else (sp.serve_buckets or None)
@@ -962,12 +978,10 @@ class ServingEngine:
                       source=source, swap_rejections=self.swap_rejections)
 
     # -- request surface ------------------------------------------------
-    def submit(self, name: str, img: np.ndarray, *, preprocess: bool = True):
-        """Enqueue one image; returns a concurrent.futures.Future whose
-        result is the model's score row (np.ndarray). Typed failures
-        (ISSUE 12): EngineUnhealthyError when the stall breaker is open,
-        ShedError when the backlog is at `serve_queue_limit`,
-        EngineClosedError after close/drain."""
+    def _shed_if_unhealthy(self) -> None:
+        """Fast-path health gate shared by every submit surface: an open
+        stall breaker sheds in the caller's thread (and kicks a
+        background recovery probe) before any decode/preprocess cost."""
         if not self._healthy:
             self._maybe_probe_async()
             self.note_unhealthy_shed()
@@ -975,6 +989,14 @@ class ServingEngine:
                 "serving engine unhealthy (dispatch stall breaker open"
                 f"{'' if not self._breaker else ': ' + str(self._breaker.get('section'))}"
                 "); request shed")
+
+    def submit(self, name: str, img: np.ndarray, *, preprocess: bool = True):
+        """Enqueue one image; returns a concurrent.futures.Future whose
+        result is the model's score row (np.ndarray). Typed failures
+        (ISSUE 12): EngineUnhealthyError when the stall breaker is open,
+        ShedError when the backlog is at `serve_queue_limit`,
+        EngineClosedError after close/drain."""
+        self._shed_if_unhealthy()
         model = self.model(name)  # KeyError for unknown models
         data = model.preprocess(img) if preprocess else \
             np.asarray(img, np.float32)
@@ -986,6 +1008,52 @@ class ServingEngine:
                 f"serving: request row shape {tuple(data.shape)} does "
                 f"not match model {name!r} input {tuple(want)}")
         return self._batcher.submit(name, data)
+
+    def decode_request(self, data: bytes) -> np.ndarray:
+        """Decode one encoded request (HTTP upload bytes) -> (3, h, w)
+        planar BGR uint8 through the training decode plane's policy +
+        counters and this engine's crc32c-keyed hot-content cache
+        (ISSUE 14, serving/ingest.py). Raises the decoder's error for
+        non-image bytes — the HTTP front maps it to a typed 400."""
+        return self.ingest.decode(data)
+
+    def submit_raw(self, name: str, raw: np.ndarray):
+        """Enqueue one DECODED request ((3, h, w) planar BGR uint8, the
+        decode plane's pixel contract). When the model's preprocessing
+        is expressible in the native fused kernel and the native plane
+        is engaged, preprocessing is DEFERRED to the batcher's window
+        close — one GIL-released call per dispatch window instead of
+        one Python chain per handler thread; otherwise this is exactly
+        the classic per-request path (bitwise pre-native behavior,
+        including under CAFFE_NATIVE_DECODE=0)."""
+        self._shed_if_unhealthy()
+        model = self.model(name)  # KeyError for unknown models
+        from . import ingest as _ingest
+        if _ingest.fused_engaged(model):
+            # count AFTER the submit: the batcher may still shed
+            # (queue limit) or refuse (closed) — a rejected request
+            # must not inflate the engagement counters
+            fut = self._batcher.submit(name, raw, raw_mode=True)
+            self.ingest._count("deferred_rows")
+            return fut
+        from ..data.decode import to_float_image
+        t0 = time.perf_counter()
+        try:
+            fut = self.submit(name, to_float_image(raw))
+        finally:
+            with self.ingest._lock:
+                self.ingest.preprocess_s += time.perf_counter() - t0
+        self.ingest._count("immediate_rows")
+        return fut
+
+    def submit_bytes(self, name: str, data: bytes):
+        """decode_request + submit_raw in one call — the library
+        spelling of the HTTP upload path (tools/bench_serving.py's
+        ingest phase drives exactly this). Sheds BEFORE decoding: an
+        unhealthy engine must not burn host CPU per rejected upload
+        (fast-fail is the breaker's whole point under overload)."""
+        self._shed_if_unhealthy()
+        return self.submit_raw(name, self.decode_request(data))
 
     def classify(self, name: str, imgs, *, preprocess: bool = True
                  ) -> np.ndarray:
@@ -1023,6 +1091,9 @@ class ServingEngine:
             "stall_s": self.stall_s,
             "swaps": self.swaps,
             "swap_rejections": self.swap_rejections,
+            # request-ingest plane (ISSUE 14): decode-path engagement,
+            # window-fused preprocess counters, hot-content cache
+            "ingest": self.ingest.stats(),
         }
         if recs:
             lat = np.sort(np.array([r["total_ms"] for r in recs]))

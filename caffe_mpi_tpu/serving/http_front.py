@@ -31,13 +31,21 @@ future, so concurrent requests are continuously batched into padded
 bucket programs (batcher.py) instead of each paying a solo forward.
 Responses are JSON top-5 {label, score} like the reference's result
 tuples.
+
+Request ingest (ISSUE 14): upload bytes decode through the engine's
+native ingest plane (`engine.decode_request` — data/decode.py policy +
+counters, crc32c hot-content cache) and preprocessing is window-fused
+by the batcher instead of running per handler thread
+(`engine.submit_raw`). Bytes no decoder accepts — corrupt uploads,
+non-image files — surface as the typed 400 `kind=bad_request` body,
+never a 500 and never a native abort (decode.cc contains codec errors
+as per-record statuses).
 """
 
 from __future__ import annotations
 
 import email
 import email.policy
-import io as _io
 import json
 import os
 import sys
@@ -80,12 +88,6 @@ def extract_image_bytes(body: bytes, content_type: str) -> bytes:
     return body
 
 
-def decode_image(img_bytes: bytes) -> np.ndarray:
-    from PIL import Image
-    img = Image.open(_io.BytesIO(img_bytes)).convert("RGB")
-    return np.asarray(img, np.float32) / 255.0
-
-
 class _Handler(BaseHTTPRequestHandler):
     # injected by make_server:
     engine = None
@@ -101,11 +103,29 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _classify(self, img: np.ndarray) -> None:
+    def _classify_bytes(self, img_bytes: bytes) -> None:
+        """Decode + submit one encoded upload: native request decode
+        (crc32c-cached) in this handler thread, preprocessing fused at
+        the batcher's window close. Undecodable bytes are the client's
+        fault — typed 400, never a 500 or a native abort."""
+        try:
+            # shed BEFORE paying decode: an open breaker must fast-fail
+            # in sub-ms, not burn a decode per rejected upload
+            self.engine._shed_if_unhealthy()
+        except ServingError as e:
+            return self._json(e.http_status,
+                              {"error": str(e), "kind": e.kind})
+        try:
+            raw = self.engine.decode_request(img_bytes)
+        except Exception as e:  # noqa: BLE001 — bad upload is a client
+            # error (the native plane declines to PIL, PIL raises here)
+            return self._json(400,
+                              {"error": f"could not decode image: {e}",
+                               "kind": "bad_request"})
         try:
             # submit + wait: the engine batches this request with every
             # other in-flight one inside the batching window
-            preds = self.engine.submit(self.model_name, img).result(
+            preds = self.engine.submit_raw(self.model_name, raw).result(
                 timeout=60)
             top = np.argsort(-preds)[:5]
             body = {"predictions": [
@@ -158,13 +178,7 @@ class _Handler(BaseHTTPRequestHandler):
                     raw = f.read()
             except OSError as e:
                 return self._json(404, {"error": str(e), "kind": "not_found"})
-            try:
-                img = decode_image(raw)
-            except Exception as e:  # exists but is not an image -> 400
-                return self._json(
-                    400, {"error": f"could not decode image: {e}",
-                          "kind": "bad_request"})
-            return self._classify(img)
+            return self._classify_bytes(raw)
         self._json(404, {"error": f"no route {url.path}",
                          "kind": "not_found"})
 
@@ -185,13 +199,13 @@ class _Handler(BaseHTTPRequestHandler):
                                     "kind": "bad_request"})
         body = self.rfile.read(length)
         try:
-            img = decode_image(extract_image_bytes(
-                body, self.headers.get("Content-Type", "")))
+            img_bytes = extract_image_bytes(
+                body, self.headers.get("Content-Type", ""))
         except Exception as e:  # bad upload is a client error, not a crash
             return self._json(400,
                               {"error": f"could not decode image: {e}",
                                "kind": "bad_request"})
-        self._classify(img)
+        self._classify_bytes(img_bytes)
 
     def log_message(self, fmt, *args):  # quiet by default
         if os.environ.get("WEB_DEMO_VERBOSE"):

@@ -10,7 +10,7 @@ Usage (gflags-compatible single-dash long flags accepted):
     python -m caffe_mpi_tpu.tools.cli test -model net.prototxt -weights w.caffemodel -iterations 50
     python -m caffe_mpi_tpu.tools.cli time -model net.prototxt -iterations 50
     python -m caffe_mpi_tpu.tools.cli device_query
-    python -m caffe_mpi_tpu.tools.cli serve -model deploy.prototxt -weights w.caffemodel [-port 5000] [-smoke N] [-serve_queue_limit Q] [-serve_deadline_ms D] [-serve_stall_s S] [-watch SNAPSHOT_PREFIX]
+    python -m caffe_mpi_tpu.tools.cli serve -model deploy.prototxt -weights w.caffemodel [-port 5000] [-smoke N] [-serve_queue_limit Q] [-serve_deadline_ms D] [-serve_stall_s S] [-serve_decoded_cache_mb M] [-watch SNAPSHOT_PREFIX]
 """
 
 from __future__ import annotations
@@ -305,6 +305,21 @@ def _parser() -> argparse.ArgumentParser:
                    "and sheds new requests until a recovery probe "
                    "succeeds (overrides ServingParameter serve_stall_s; "
                    "-1 = schema default 0 = breaker off)")
+    p.add_argument("-require_native_ingest", "--require-native-ingest",
+                   dest="require_native_ingest", action="store_true",
+                   help="serve -smoke: fail unless the HTTP leg's "
+                   "requests actually decoded natively and preprocessed "
+                   "through the window-fused plane (tpu_validation's "
+                   "serve stage — a silent PIL fallback on hardware "
+                   "would invalidate the serving ingest numbers)")
+    p.add_argument("-serve_decoded_cache_mb", "--serve-decoded-cache-mb",
+                   dest="serve_decoded_cache_mb", type=float, default=-1.0,
+                   help="serve: hot-content decoded-request cache budget "
+                   "in MiB — decoded uploads are kept in RAM keyed by "
+                   "the crc32c of their encoded bytes (LRU), so repeated "
+                   "hot images skip JPEG/PNG decode entirely (overrides "
+                   "ServingParameter serve_decoded_cache_mb; -1 = schema "
+                   "default 0 = cache off)")
     p.add_argument("-watch", "--watch", dest="serve_watch", default="",
                    help="serve: snapshot prefix to tail for verified "
                    "hot-swaps — each newly crc32c-verified snapshot is "
@@ -991,6 +1006,8 @@ def cmd_serve(args) -> int:
         sp.serve_deadline_ms = args.serve_deadline_ms
     if args.serve_stall_s >= 0:
         sp.serve_stall_s = args.serve_stall_s
+    if args.serve_decoded_cache_mb >= 0:
+        sp.serve_decoded_cache_mb = args.serve_decoded_cache_mb
     # serving run journal (<model>.serve.run.json): breaker trips, hot
     # swaps + rejections, shutdown — next to the deploy prototxt
     engine = ServingEngine(sp, journal=os.path.splitext(args.model)[0])
@@ -1089,8 +1106,24 @@ def _serve_smoke(args, engine, srv) -> int:
         engine.drain()
         stats = engine.stats()
         stats["post_warmup_compiles"] = engine.compile_count - warmed
+        # decode-path engagement at a glance (ISSUE 14): the HTTP leg is
+        # the request-ingest path — which decoder ran and whether the
+        # window-fused preprocess engaged (full counters under "ingest")
+        ing = stats["ingest"]
+        stats["native_ingest_engaged"] = bool(
+            ing["decode_plane"]["native_records"] > 0
+            and ing["fused_rows"] > 0)
         print(json.dumps({"serve_smoke": stats}))
         if http_err is not None:
+            return 1
+        if args.require_native_ingest and (
+                sent_http == 0 or not stats["native_ingest_engaged"]):
+            log.error(
+                "serve smoke: native ingest did NOT engage (http leg "
+                "%d reqs, native decodes %d, fused rows %d) — build "
+                "the native plane with caffe_mpi_tpu/native/build.sh",
+                sent_http, ing["decode_plane"]["native_records"],
+                ing["fused_rows"])
             return 1
         if stats["post_warmup_compiles"] != 0 or \
                 engine.compile_count != engine.warmed_buckets:

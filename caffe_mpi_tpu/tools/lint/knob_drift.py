@@ -62,6 +62,7 @@ KNOBS = (
     "serve_queue_limit",  # ISSUE 12: load-shedding admission control
     "serve_deadline_ms",  # ISSUE 12: per-request dispatch deadline
     "serve_stall_s",    # ISSUE 12: serving dispatch stall breaker
+    "serve_decoded_cache_mb",  # ISSUE 14: hot-content request cache
 )
 
 CONFIG_FILE = os.path.join("caffe_mpi_tpu", "proto", "config.py")

@@ -215,12 +215,23 @@ class TestMeshFlag:
         with pytest.raises(ValueError, match="param_sharding"):
             Solver(sp, mesh=MeshPlan.from_shape(4, 2))
 
-    @pytest.mark.slow
     def test_resnet50_cli_mesh_tp_matches_dp(self, tmp_path, monkeypatch):
         """The north-star launch: `caffe train -mesh data=4,model=2` on
         ResNet-50 with prototxt TP rules, parameter-trajectory-matching
         the same-mesh replicated run (float-reassociation tolerance:
-        sharded contractions reduce in a different order)."""
+        sharded contractions reduce in a different order).
+
+        One iteration, deliberately (the PR-2..PR-12 "flake", root-caused
+        in ISSUE 14): the TP-vs-DP contract — same math modulo float
+        reassociation — is only testable before the divergence becomes
+        chaotic. Measured on this net: after 1 step every param agrees
+        to 1.9e-4; after 2 steps the same comparison reads 9.7e-3 (~50x
+        per-step amplification as step 1's reassociation-level deltas
+        feed BatchNorm batch statistics and a 176-layer backward), which
+        straddled the old 2-iter/5e-3 assert depending on XLA scheduling.
+        The CLI surface exercised (sharding-rule collection, mesh launch,
+        train step, snapshot) is identical at 1 iter, so this runs in
+        tier-1 instead of hiding behind a slow mark."""
         import os
         from caffe_mpi_tpu.io import load_caffemodel
         from caffe_mpi_tpu.proto import NetParameter
@@ -240,14 +251,16 @@ class TestMeshFlag:
         for tag in ("tp", "dp"):
             (tmp_path / f"solver_{tag}.prototxt").write_text(
                 f'net: "net_{tag}.prototxt"\nbase_lr: 0.001\n'
-                'lr_policy: "fixed"\nmomentum: 0.9\nmax_iter: 2\n'
-                f'display: 0\nsnapshot: 2\nsnapshot_prefix: "{tag}"\n'
+                'lr_policy: "fixed"\nmomentum: 0.9\nmax_iter: 1\n'
+                f'display: 0\nsnapshot: 1\nsnapshot_prefix: "{tag}"\n'
                 'type: "SGD"\nrandom_seed: 5\n')
             assert main(["train", "-solver", str(tmp_path / f"solver_{tag}.prototxt"),
                          "-mesh", "data=4,model=2", "-synthetic"]) == 0
-        a = load_caffemodel(str(tmp_path / "tp_iter_2.caffemodel"))
-        b = load_caffemodel(str(tmp_path / "dp_iter_2.caffemodel"))
+        a = load_caffemodel(str(tmp_path / "tp_iter_1.caffemodel"))
+        b = load_caffemodel(str(tmp_path / "dp_iter_1.caffemodel"))
         assert a.keys() == b.keys()
         for k in a:
             for x, y in zip(a[k], b[k]):
-                np.testing.assert_allclose(x, y, atol=5e-3)
+                # 5x headroom over the measured 1-step reassociation
+                # envelope (1.9e-4, conv1) — see the docstring
+                np.testing.assert_allclose(x, y, atol=1e-3)

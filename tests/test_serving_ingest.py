@@ -1,0 +1,480 @@
+"""Serving request-ingest tests (ISSUE 14): native request decode
+parity, window-fused preprocessing row-identity, the hot-content
+decoded-request cache, PIL fallback for declines, the bitwise
+pre-native path under CAFFE_NATIVE_DECODE=0, the typed-400 contract
+for corrupt uploads, and the zero-recompile invariant held throughout.
+
+Parity contracts under test (docs/serving.md "Native request ingest"):
+  * decode — PNG bitwise vs PIL, JPEG <= 1 LSB per pixel (the decode
+    plane's documented contract, data/decode.py);
+  * preprocess — the native fused kernel (transform_core.h
+    serve_preprocess_one: u8/255 -> PIL-convention F-mode BILINEAR
+    resize -> center crop -> raw_scale/mean/input_scale) is BITWISE
+    equal to the Python per-request chain (caffe_io.resize_center_crop
+    + Transformer.preprocess) for the same decoded pixels;
+  * scores — with a pinned single-bucket ladder (one compiled program,
+    so PR 7's ~1e-15 cross-program reduction-order variance cannot
+    leak in), serving the same PNG trace native vs pre-native is
+    bitwise score-identical.
+"""
+
+import io
+import json
+import os
+import subprocess
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from caffe_mpi_tpu import caffe_io, native
+from caffe_mpi_tpu.data import decode as dmod
+from caffe_mpi_tpu.serving import ServingEngine, ingest
+from caffe_mpi_tpu.serving.http_front import make_server
+
+DEPLOY = """
+name: "toy"
+layer { name: "data" type: "Input" top: "data"
+        input_param { shape { dim: 4 dim: 3 dim: 8 dim: 8 } } }
+layer { name: "conv" type: "Convolution" bottom: "data" top: "c"
+        convolution_param { num_output: 6 kernel_size: 3
+          weight_filler { type: "xavier" } } }
+layer { name: "ip" type: "InnerProduct" bottom: "c" top: "score"
+        inner_product_param { num_output: 5
+          weight_filler { type: "xavier" } } }
+layer { name: "prob" type: "Softmax" bottom: "score" top: "prob" }
+"""
+
+PRE = dict(mean=np.array([0.1, 0.2, 0.3], np.float32), raw_scale=255.0,
+           channel_swap=(2, 1, 0))
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built():
+    if not native.available():
+        script = os.path.join(os.path.dirname(native.__file__), "build.sh")
+        try:
+            subprocess.run(["sh", script], check=True, capture_output=True)
+        except (OSError, subprocess.CalledProcessError):
+            pytest.skip("native toolchain unavailable")
+        native._TRIED = False  # re-probe
+    if not (native.available() and native.decode_available()
+            and native.serve_preprocess_available()):
+        pytest.skip("native ingest plane unavailable (no libjpeg/libpng "
+                    "at build time) — PIL fallback covers production")
+
+
+@pytest.fixture(autouse=True)
+def clean_env(monkeypatch):
+    monkeypatch.delenv("CAFFE_NATIVE_DECODE", raising=False)
+    dmod.STATS.reset()
+
+
+@pytest.fixture(scope="module")
+def deploy(tmp_path_factory):
+    p = tmp_path_factory.mktemp("serve_ingest") / "deploy.prototxt"
+    p.write_text(DEPLOY)
+    return str(p)
+
+
+def _encode(img_hwc_rgb, fmt, **kw):
+    from PIL import Image
+    b = io.BytesIO()
+    Image.fromarray(img_hwc_rgb).save(b, fmt, **kw)
+    return b.getvalue()
+
+
+def _png(seed, hw=(12, 12)):
+    rng = np.random.RandomState(seed)
+    return _encode(rng.randint(0, 256, (*hw, 3), np.uint8), "PNG")
+
+
+def _pil_chw(data):
+    from PIL import Image
+    img = np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
+    return img[:, :, ::-1].transpose(2, 0, 1)
+
+
+def _engine(deploy, **kw):
+    # single-bucket ladder: one compiled program for every dispatch, so
+    # cross-pass score comparisons are bitwise (see module docstring)
+    kw.setdefault("buckets", "4")
+    kw.setdefault("window_ms", 5)
+    pre = kw.pop("pre", PRE)
+    eng = ServingEngine(**kw)
+    eng.load_model("m", deploy, **pre)
+    return eng
+
+
+class TestRequestDecodeParity:
+    def test_png_bitwise_vs_pil(self):
+        eng = ServingEngine(start=False)
+        data = _png(0, (19, 23))
+        nat = eng.decode_request(data)
+        np.testing.assert_array_equal(nat, _pil_chw(data))
+        assert dmod.STATS.snapshot()["native_records"] == 1
+        eng.close()
+
+    def test_jpeg_within_one_lsb(self, rng):
+        eng = ServingEngine(start=False)
+        data = _encode(rng.randint(0, 256, (21, 17, 3)).astype(np.uint8),
+                       "JPEG", quality=90)
+        nat = eng.decode_request(data).astype(np.int16)
+        ref = _pil_chw(data).astype(np.int16)
+        assert np.abs(nat - ref).max() <= 1
+        eng.close()
+
+    def test_forced_pil_is_prenative_bitwise(self, monkeypatch):
+        data = _png(1)
+        monkeypatch.setenv("CAFFE_NATIVE_DECODE", "0")
+        eng = ServingEngine(start=False)
+        arr = eng.decode_request(data)
+        np.testing.assert_array_equal(arr, _pil_chw(data))
+        snap = dmod.STATS.snapshot()
+        assert snap["pil_records"] == 1 and snap["native_records"] == 0
+        eng.close()
+
+
+class TestFusedPreprocessParity:
+    def test_native_kernel_bitwise_vs_python_chain(self, rng):
+        """The load-bearing unit contract: serve_preprocess_batch ==
+        the per-request Python chain (resize_center_crop + Transformer)
+        BITWISE, across resize/crop/swap/raw/mean/input_scale combos —
+        including the PIL-convention F-mode BILINEAR resample."""
+        cases = [
+            # (h, w, image_dims, crop_dims, swap_rgb, raw, mean, iscale)
+            (37, 53, (24, 24), (24, 24), (2, 1, 0), 255.0,
+             np.array([104., 117., 123.], np.float32), None),
+            (10, 10, (8, 8), (8, 8), None, None, None, None),
+            (12, 12, (12, 12), (8, 8), (2, 1, 0), 255.0, None, 0.0078125),
+            (64, 48, (32, 32), (28, 28), (1, 0, 2), 128.0,
+             np.array([1., 2., 3.], np.float32), 2.5),
+            (8, 8, (16, 16), (16, 16), None, 255.0, None, None),
+        ]
+        for h, w, img_d, crop_d, swap_rgb, raw, mean, iscale in cases:
+            u8 = np.ascontiguousarray(
+                rng.randint(0, 256, (3, h, w)).astype(np.uint8))  # BGR CHW
+            img = dmod.to_float_image(u8)
+            ref = caffe_io.resize_center_crop(img, img_d, crop_d)
+            ref = ref.transpose(2, 0, 1)
+            if swap_rgb is not None:
+                ref = ref[np.array(swap_rgb), :, :]
+            if raw is not None:
+                ref = ref * raw
+            if mean is not None:
+                ref = ref - mean.reshape(3, 1, 1)
+            if iscale is not None:
+                ref = ref * iscale
+            sw = [2 - (swap_rgb[j] if swap_rgb else j) for j in range(3)]
+            out, status = native.serve_preprocess_batch(
+                [u8], img_h=img_d[0], img_w=img_d[1], crop_h=crop_d[0],
+                crop_w=crop_d[1], swap=sw, raw_scale=raw, mean=mean,
+                input_scale=iscale)
+            assert (status == 0).all()
+            np.testing.assert_array_equal(out[0],
+                                          np.asarray(ref, np.float32))
+
+    def test_window_fused_scores_bitwise_vs_prenative(self, deploy,
+                                                      monkeypatch):
+        """The e2e row-identity claim: the same PNG trace served through
+        the native window-fused path and through the bitwise pre-native
+        path (CAFFE_NATIVE_DECODE=0: PIL decode + per-request Python
+        preprocess in the caller's thread) scores IDENTICALLY — resize
+        engaged (12x12 uploads into the 8x8-input net)."""
+        trace = [_png(i) for i in range(10)]
+        eng = _engine(deploy)
+        futs = [eng.submit_bytes("m", b) for b in trace]
+        nat_scores = np.stack([f.result(60) for f in futs])
+        st = eng.ingest.stats()
+        assert st["fused_rows"] == 10 and st["immediate_rows"] == 0
+        assert st["fused_fallback_rows"] == 0
+        assert eng.compile_count == eng.warmed_buckets
+        eng.close()
+
+        monkeypatch.setenv("CAFFE_NATIVE_DECODE", "0")
+        eng = _engine(deploy)
+        futs = [eng.submit_bytes("m", b) for b in trace]
+        pil_scores = np.stack([f.result(60) for f in futs])
+        st = eng.ingest.stats()
+        assert st["immediate_rows"] == 10 and st["fused_rows"] == 0
+        assert eng.compile_count == eng.warmed_buckets
+        eng.close()
+
+        np.testing.assert_array_equal(nat_scores, pil_scores)
+
+    def test_prenative_path_matches_classic_submit(self, deploy,
+                                                   monkeypatch):
+        """CAFFE_NATIVE_DECODE=0 submit_bytes IS the pre-ISSUE-14
+        pipeline: PIL float decode + engine.submit — bitwise, same
+        engine, same program."""
+        from PIL import Image
+        monkeypatch.setenv("CAFFE_NATIVE_DECODE", "0")
+        eng = _engine(deploy)
+        for i in range(4):
+            data = _png(20 + i)
+            img = np.asarray(Image.open(io.BytesIO(data)).convert("RGB"),
+                             np.float32) / 255.0
+            a = eng.submit_bytes("m", data).result(60)
+            b = eng.submit("m", img).result(60)
+            np.testing.assert_array_equal(a, b)
+        assert eng.compile_count == eng.warmed_buckets
+        eng.close()
+
+    def test_full_image_mean_model_falls_back_classic(self, deploy):
+        """A model whose preprocessing the fused kernel cannot express
+        (full-image mean) keeps the classic per-request path — no plan,
+        no fused rows, requests still serve."""
+        full_mean = np.full((3, 8, 8), 0.25, np.float32)
+        eng = _engine(deploy, pre=dict(mean=full_mean, raw_scale=255.0))
+        assert eng.model("m").ingest_plan is None
+        f = eng.submit_bytes("m", _png(3))
+        assert f.result(60).shape == (5,)
+        st = eng.ingest.stats()
+        assert st["immediate_rows"] == 1 and st["fused_rows"] == 0
+        assert eng.compile_count == eng.warmed_buckets
+        eng.close()
+
+
+class TestDecodedRequestCache:
+    def test_hot_repeat_zero_decode_calls(self, deploy):
+        eng = _engine(deploy, decoded_cache_mb=4)
+        hot = _png(7)
+        eng.submit_bytes("m", hot).result(60)
+        before = dmod.STATS.snapshot()["decode_calls"]
+        futs = [eng.submit_bytes("m", hot) for _ in range(5)]
+        scores = np.stack([f.result(60) for f in futs])
+        assert dmod.STATS.snapshot()["decode_calls"] == before
+        st = eng.ingest.stats()
+        assert st["cache_hits"] == 5 and st["cache_misses"] == 1
+        assert st["cache_inserts"] == 1
+        # cached repeats still score — and identically to each other
+        assert np.array_equal(scores, np.repeat(scores[:1], 5, axis=0))
+        assert eng.compile_count == eng.warmed_buckets
+        eng.close()
+
+    def test_lru_eviction_bounded_by_budget(self, deploy):
+        # an entry charges decoded pixels (12x12x3 = 432) PLUS the
+        # stored encoded bytes (the exact-identity check's cost); size
+        # the budget to hold exactly two entries
+        entries = [432 + len(_png(30 + i)) for i in range(4)]
+        budget = entries[0] + entries[1] + min(entries) // 2
+        eng = _engine(deploy, decoded_cache_mb=budget / 2**20)
+        for i in range(4):
+            eng.submit_bytes("m", _png(30 + i)).result(60)
+        st = eng.ingest.stats()
+        assert st["cache_inserts"] == 4 and st["cache_evictions"] == 2
+        assert st["cache_bytes"] <= budget
+        # the two newest stay hot, the two oldest were evicted
+        before = dmod.STATS.snapshot()["decode_calls"]
+        eng.submit_bytes("m", _png(33)).result(60)
+        assert dmod.STATS.snapshot()["decode_calls"] == before
+        eng.submit_bytes("m", _png(30)).result(60)
+        assert dmod.STATS.snapshot()["decode_calls"] == before + 1
+        eng.close()
+
+    def test_oversized_record_not_cached(self, deploy):
+        eng = _engine(deploy, decoded_cache_mb=100 / 2**20)  # 100 bytes
+        eng.submit_bytes("m", _png(40)).result(60)
+        st = eng.ingest.stats()
+        assert st["cache_inserts"] == 0 and st["cache_bytes"] == 0
+        eng.close()
+
+    def test_crc_collision_never_serves_wrong_pixels(self, monkeypatch):
+        """Review regression: crc32c is 32 bits (and linear — a
+        colliding file is craftable), so a HIT must verify exact
+        encoded-byte identity. Simulated collision: every request
+        hashes to the same key; the second image must still decode to
+        ITS OWN pixels, never the first's cached decode."""
+        from caffe_mpi_tpu.serving import ingest as ing
+        monkeypatch.setattr(ing, "_content_key", lambda data: 42)
+        eng = ServingEngine(decoded_cache_mb=4, start=False)
+        a, b = _png(90), _png(91)
+        pix_a = eng.decode_request(a)
+        pix_b = eng.decode_request(b)  # same key, different bytes
+        np.testing.assert_array_equal(pix_b, _pil_chw(b))
+        assert not np.array_equal(pix_a, pix_b)
+        st = eng.ingest.stats()
+        assert st["cache_hits"] == 0 and st["cache_misses"] == 2
+        # the newer content replaced the colliding entry, bytes-exact:
+        # b now hits, a now misses (decodes fresh, still correct)
+        assert np.array_equal(eng.decode_request(b), pix_b)
+        assert eng.ingest.stats()["cache_hits"] == 1
+        np.testing.assert_array_equal(eng.decode_request(a), _pil_chw(a))
+        eng.close()
+
+    def test_negative_cache_budget_rejected(self):
+        with pytest.raises(ValueError, match="serve_decoded_cache_mb"):
+            ServingEngine(decoded_cache_mb=-1, start=False)
+
+    def test_racing_duplicate_inserts_account_bytes_once(self, deploy):
+        """Review regression: two handler threads missing on the same
+        hot image concurrently must not double-count cache_bytes (a
+        blind overwrite left phantom bytes shrinking the budget until
+        the cache degraded to a 0% hit rate)."""
+        eng = ServingEngine(decoded_cache_mb=4, start=False)
+        data = _png(70)
+        nbytes = eng.decode_request(data).nbytes
+        barrier = threading.Barrier(8)
+
+        def hammer():
+            barrier.wait()
+            for _ in range(20):
+                eng.decode_request(data)
+
+        threads = [threading.Thread(target=hammer) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        st = eng.ingest.stats()
+        assert st["cache_bytes"] == nbytes + len(data)
+        assert st["cache_inserts"] == 1
+        assert st["cache_hits"] + st["cache_misses"] == 161
+        eng.close()
+
+
+class TestDeclinesAndFallback:
+    def test_sixteen_bit_png_declines_to_pil(self, deploy, rng):
+        """An alpha/16-bit PNG is outside the native decoder's parity
+        envelope — it must decline to PIL (coverage never shrinks) and
+        the request must still serve through the fused window."""
+        from PIL import Image
+        b = io.BytesIO()
+        Image.fromarray(rng.randint(0, 2**16, (12, 12)).astype(np.uint16)
+                        ).save(b, "PNG")
+        eng = _engine(deploy)
+        f = eng.submit_bytes("m", b.getvalue())
+        assert f.result(60).shape == (5,)
+        snap = dmod.STATS.snapshot()
+        assert snap["native_fallbacks"] == 1 and snap["pil_records"] == 1
+        assert eng.compile_count == eng.warmed_buckets
+        eng.close()
+
+    def test_corrupt_bytes_raise_in_caller_thread(self, deploy):
+        eng = _engine(deploy)
+        with pytest.raises(Exception):
+            eng.submit_bytes("m", b"these are not image bytes")
+        # a truncated JPEG: valid magic, rotten entropy data — the
+        # native decoder returns a status (never aborts), PIL raises
+        jpeg = _encode(np.zeros((16, 16, 3), np.uint8), "JPEG")
+        with pytest.raises(Exception):
+            eng.submit_bytes("m", jpeg[:24])
+        eng.close()
+
+
+class TestHTTPFront:
+    @pytest.fixture()
+    def server(self, deploy):
+        eng = _engine(deploy, decoded_cache_mb=2)
+        srv = make_server(eng, "m", labels=[f"c{i}" for i in range(5)],
+                          port=0)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        yield f"http://127.0.0.1:{srv.server_address[1]}", eng
+        srv.shutdown()
+        eng.close()
+
+    def test_upload_serves_through_native_ingest(self, server):
+        base, eng = server
+        req = urllib.request.Request(base + "/classify", data=_png(50),
+                                     headers={"Content-Type": "image/png"})
+        out = json.loads(urllib.request.urlopen(req, timeout=60).read())
+        assert len(out["predictions"]) == 5
+        st = eng.ingest.stats()
+        assert st["requests"] == 1
+        assert st["deferred_rows"] == 1  # window-fused, not per-handler
+        assert st["decode_plane"]["native_records"] >= 1
+
+    def test_corrupt_upload_typed_400_bad_request(self, server):
+        """ISSUE 14 satellite: corrupt/undecodable bytes through the
+        native path map to the typed 400 kind=bad_request body — never
+        a 500, never a native abort."""
+        base, eng = server
+        for payload in (b"definitely not an image",
+                        _encode(np.zeros((16, 16, 3), np.uint8),
+                                "JPEG")[:24]):
+            req = urllib.request.Request(
+                base + "/classify", data=payload,
+                headers={"Content-Type": "image/png"})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=60)
+            assert e.value.code == 400
+            body = json.loads(e.value.read())
+            assert body["kind"] == "bad_request"
+        # the engine survived: a good upload still classifies, and
+        # steady-state serving never compiled
+        req = urllib.request.Request(base + "/classify", data=_png(51),
+                                     headers={"Content-Type": "image/png"})
+        out = json.loads(urllib.request.urlopen(req, timeout=60).read())
+        assert len(out["predictions"]) == 5
+        assert eng.compile_count == eng.warmed_buckets
+
+    def test_stats_reports_ingest_block(self, server):
+        base, eng = server
+        urllib.request.urlopen(
+            urllib.request.Request(
+                base + "/classify", data=_png(52),
+                headers={"Content-Type": "image/png"}), timeout=60).read()
+        st = json.loads(urllib.request.urlopen(base + "/stats",
+                                               timeout=60).read())
+        assert "ingest" in st
+        assert st["ingest"]["cache_budget_mb"] == 2.0
+        assert "decode_plane" in st["ingest"]
+
+
+class TestShedAndHealthGates:
+    def test_unhealthy_engine_sheds_before_decode(self, deploy):
+        """Review regression: an open stall breaker must fast-fail
+        submit_bytes BEFORE any decode cost — rejected uploads cannot
+        burn host CPU during the exact overload shedding exists for."""
+        from caffe_mpi_tpu.serving import EngineUnhealthyError
+        eng = _engine(deploy)
+        eng._healthy = False
+        with pytest.raises(EngineUnhealthyError):
+            eng.submit_bytes("m", _png(80))
+        st = eng.ingest.stats()
+        assert st["requests"] == 0  # never reached the decode plane
+        eng._healthy = True
+        eng.close()
+
+    def test_shed_requests_do_not_inflate_engagement_counters(
+            self, deploy):
+        """Review regression: a batcher-level shed (queue limit) must
+        not count deferred_rows — the request never entered the queue,
+        and engagement checks compare deferred vs fused rows."""
+        from caffe_mpi_tpu.serving import ShedError
+        # a huge window parks the first request; limit 1 sheds the next
+        eng = ServingEngine(window_ms=10_000, queue_limit=1, buckets="4")
+        eng.load_model("m", deploy, **PRE)
+        first = eng.submit_bytes("m", _png(81))
+        shed = 0
+        for i in range(3):
+            try:
+                eng.submit_bytes("m", _png(82 + i))
+            except ShedError:
+                shed += 1
+        assert shed == 3
+        assert eng.ingest.stats()["deferred_rows"] == 1
+        eng.close()
+        assert first.done()
+
+
+class TestZeroRecompile:
+    def test_mixed_ingest_traffic_never_recompiles(self, deploy,
+                                                   monkeypatch):
+        """The PR 7 invariant held across the whole ingest surface:
+        mixed-size uploads, cache hits, PIL declines, env flips — the
+        compiled ladder never grows past its warm count."""
+        eng = ServingEngine(window_ms=2, decoded_cache_mb=2)
+        eng.load_model("m", deploy, **PRE)
+        warmed = eng.warmed_buckets
+        futs = [eng.submit_bytes("m", _png(i % 3, hw=(10 + i % 4, 12)))
+                for i in range(20)]
+        monkeypatch.setenv("CAFFE_NATIVE_DECODE", "0")
+        futs += [eng.submit_bytes("m", _png(60 + i)) for i in range(5)]
+        for f in futs:
+            assert f.result(60).shape == (5,)
+        assert eng.compile_count == warmed == eng.warmed_buckets
+        eng.close()

@@ -204,11 +204,17 @@ for causal in (False, True):
             # 64 mixed-size synthetic requests — a few over real HTTP —
             # and exit nonzero if steady-state serving compiled
             # anything; the printed serve_smoke JSON carries hardware
-            # p50/p99 latency and sustained img/s
+            # p50/p99 latency and sustained img/s. -require_native_ingest
+            # (ISSUE 14): the HTTP leg must decode natively and
+            # preprocess through the window-fused plane — a silent PIL
+            # fallback on hardware would invalidate the serving ingest
+            # numbers (the serving analogue of the e2e stage's
+            # --require-native-decode)
             run("serve-smoke",
                 [py, "-m", "caffe_mpi_tpu.tools.cli", "serve",
                  "-model", "models/cifar10_quick/deploy.prototxt",
-                 "-smoke", "64", "-serve_window_ms", "10"],
+                 "-smoke", "64", "-serve_window_ms", "10",
+                 "-require_native_ingest"],
                 600, log)
             # verified hot-swap over the real tunnel (ISSUE 12,
             # docs/serving.md Resilience): a SnapshotWatcher tails a

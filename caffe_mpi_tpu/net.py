@@ -125,6 +125,7 @@ class Net:
                     "wins — bf16 did not engage net-wide (per-layer "
                     "forward_type overrides may still apply)",
                     net_fwd, net_bwd)
+        from .proto.netshape import BF16_INELIGIBLE
         for lp in param.layer:
             policy = DtypePolicy.resolve(
                 lp.forward_type, lp.backward_type,
@@ -133,6 +134,17 @@ class Net:
                 lp.forward_math, param.default_forward_math,
                 lp.backward_math, param.default_backward_math,
             )
+            if policy.forward == jnp.bfloat16 and lp.type in BF16_INELIGIBLE:
+                # one registry with netlint's net-dtype pass (ISSUE 15):
+                # host-callback/IO layers run f32 buffers regardless, so
+                # a bf16 request here is silently not honored — warn at
+                # build (netlint flags the same statically)
+                log.warning(
+                    "layer %s (%s): FLOAT16 compute requested but the "
+                    "layer is bf16-ineligible (host callback / IO — see "
+                    "proto/netshape.py BF16_INELIGIBLE); it will compute "
+                    "in f32. Pin `forward_type: FLOAT` to silence.",
+                    lp.name, lp.type)
             if lp.type in ("Data", "ImageData", "Input") and batch_divisor > 1:
                 # copy-on-write: the NetParameter is often SHARED between
                 # the train net (divided) and test nets / the caller's

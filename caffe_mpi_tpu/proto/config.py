@@ -71,27 +71,31 @@ class Message:
 
     @classmethod
     def _schema(cls):
-        """Per-class (fields, resolved hints) cache — from_node runs once per
-        node in a net with hundreds of layers, so hint resolution must not."""
+        """Per-class (fields, resolved hints, name->field map) cache —
+        from_node runs once per node in a net with hundreds of layers,
+        so hint resolution must not."""
         cached = _SCHEMA_CACHE.get(cls)
         if cached is None:
-            cached = (dataclasses.fields(cls), typing.get_type_hints(cls))
+            fields = dataclasses.fields(cls)
+            cached = (fields, typing.get_type_hints(cls),
+                      {f.name: f for f in fields
+                       if not f.name.startswith("_")})
             _SCHEMA_CACHE[cls] = cached
         return cached
 
     @classmethod
     def from_node(cls, node: PbNode):
-        fields, hints = cls._schema()
+        _fields, hints, field_map = cls._schema()
         kwargs: dict[str, Any] = {}
-        known = set()
-        for f in fields:
-            if f.name.startswith("_"):
+        known = field_map.keys()
+        # iterate the fields PRESENT in the node (a layer sets a
+        # handful) rather than the full schema (LayerParameter declares
+        # ~60) — the prototxt-load hot path for big nets
+        for name, vals in node.fields.items():
+            f = field_map.get(name)
+            if f is None or not vals:
                 continue
-            known.add(f.name)
             target = hints[f.name]
-            vals = node.get_list(f.name)
-            if not vals:
-                continue
             origin = get_origin(target)
             if origin is typing.Union or origin is types.UnionType:
                 non_none = [a for a in get_args(target) if a is not type(None)]
@@ -106,7 +110,6 @@ class Message:
             except TypeError as e:
                 raise TypeError(f"{cls.__name__}.{f.name}: {e}") from e
         obj = cls(**kwargs)
-        obj._unknown = sorted(set(node.keys()) - known)
         obj._node = node
         return obj
 
@@ -120,13 +123,25 @@ class Message:
 
     @property
     def unknown_fields(self) -> list[str]:
-        return getattr(self, "_unknown", [])
+        """Fields present in the source text but absent from the
+        schema. Computed lazily (and cached as `_unknown`) — the eager
+        per-node set difference was measurable across a 370-layer
+        net's ~9k message nodes, and almost nothing reads this."""
+        cached = getattr(self, "_unknown", None)
+        if cached is None:
+            node = getattr(self, "_node", None)
+            if node is None:
+                return []
+            _f, _h, field_map = type(self)._schema()
+            cached = sorted(set(node.keys()) - field_map.keys())
+            self._unknown = cached
+        return cached
 
     def to_node(self) -> PbNode:
         """Serialize back to a text-format tree. Emits only fields that
         differ from their defaults (proto2 printer behavior); enum-valued
         string fields print unquoted."""
-        fields, hints = type(self)._schema()
+        fields, hints, _field_map = type(self)._schema()
         node = PbNode()
         for f in fields:
             if f.name.startswith("_"):

@@ -133,36 +133,35 @@ _ESCAPES = {
 }
 
 
-class _Token:
-    __slots__ = ("kind", "text", "line", "col")
+# One token is a plain (kind, text, pos) tuple — the tokenizer runs for
+# every prototxt load (inception_v3: ~80k tokens), and per-token object
+# construction / eager newline accounting dominated it. line:col is
+# recovered from `pos` by _loc() on the (rare) error paths only.
+_Token = tuple
 
-    def __init__(self, kind: str, text: str, line: int, col: int):
-        self.kind = kind
-        self.text = text
-        self.line = line
-        self.col = col
+
+def _loc(src: str, pos: int) -> str:
+    line = src.count("\n", 0, pos) + 1
+    col = pos - (src.rfind("\n", 0, pos) + 1) + 1
+    return f"line {line}:{col}"
 
 
 def _tokenize(text: str) -> list[_Token]:
     tokens: list[_Token] = []
-    pos, line, line_start = 0, 1, 0
-    n = len(text)
-    while pos < n:
-        m = _TOKEN_RE.match(text, pos)
-        if m is None:
-            col = pos - line_start + 1
-            raise PrototxtError(
-                f"line {line}:{col}: unexpected character {text[pos]!r}"
-            )
+    append = tokens.append
+    pos = 0
+    skip = ("ws", "comment")
+    for m in _TOKEN_RE.finditer(text):
+        if m.start() != pos:
+            break  # gap: unmatchable character at `pos`
         kind = m.lastgroup
-        tok_text = m.group()
-        if kind not in ("ws", "comment"):
-            tokens.append(_Token(kind, tok_text, line, pos - line_start + 1))
-        nl = tok_text.count("\n")
-        if nl:
-            line += nl
-            line_start = m.start() + tok_text.rindex("\n") + 1
+        if kind not in skip:
+            append((kind, m.group(), m.start()))
         pos = m.end()
+    if pos != len(text):
+        raise PrototxtError(
+            f"{_loc(text, pos)}: unexpected character {text[pos]!r}"
+        )
     return tokens
 
 
@@ -219,8 +218,9 @@ def _parse_number(text: str) -> int | float:
 # ---------------------------------------------------------------------------
 
 class _Parser:
-    def __init__(self, tokens: list[_Token]):
+    def __init__(self, tokens: list[_Token], src: str = ""):
         self.tokens = tokens
+        self.src = src
         self.pos = 0
 
     def peek(self) -> _Token | None:
@@ -235,9 +235,10 @@ class _Parser:
 
     def expect(self, text: str) -> _Token:
         tok = self.next()
-        if tok.text != text:
+        if tok[1] != text:
             raise PrototxtError(
-                f"line {tok.line}:{tok.col}: expected {text!r}, got {tok.text!r}"
+                f"{_loc(self.src, tok[2])}: expected {text!r}, "
+                f"got {tok[1]!r}"
             )
         return tok
 
@@ -249,53 +250,53 @@ class _Parser:
                 if terminator is None:
                     return node
                 raise PrototxtError(f"unexpected end of input, expected {terminator!r}")
-            if terminator is not None and tok.text == terminator:
+            if terminator is not None and tok[1] == terminator:
                 self.next()
                 return node
-            if tok.text in (";", ","):  # optional field separators
+            if tok[1] in (";", ","):  # optional field separators
                 self.next()
                 continue
             self.parse_field(node)
 
     def parse_field(self, node: PbNode) -> None:
         name_tok = self.next()
-        if name_tok.kind != "ident":
+        if name_tok[0] != "ident":
             raise PrototxtError(
-                f"line {name_tok.line}:{name_tok.col}: expected field name, "
-                f"got {name_tok.text!r}"
+                f"{_loc(self.src, name_tok[2])}: expected field name, "
+                f"got {name_tok[1]!r}"
             )
-        name = name_tok.text
+        name = name_tok[1]
         tok = self.peek()
         if tok is None:
             raise PrototxtError(f"unexpected end of input after field {name!r}")
-        if tok.text == "{" or tok.text == "<":
+        if tok[1] == "{" or tok[1] == "<":
             self.next()
-            node.add(name, self.parse_message("}" if tok.text == "{" else ">"))
+            node.add(name, self.parse_message("}" if tok[1] == "{" else ">"))
             return
         self.expect(":")
         tok = self.peek()
-        if tok is not None and (tok.text == "{" or tok.text == "<"):
+        if tok is not None and (tok[1] == "{" or tok[1] == "<"):
             # `name: { ... }` is legal text format for message fields
             self.next()
-            node.add(name, self.parse_message("}" if tok.text == "{" else ">"))
+            node.add(name, self.parse_message("}" if tok[1] == "{" else ">"))
             return
-        if tok is not None and tok.text == "[":
+        if tok is not None and tok[1] == "[":
             self.next()
             while True:
                 t = self.peek()
                 if t is None:
                     raise PrototxtError("unterminated list")
-                if t.text == "]":
+                if t[1] == "]":
                     self.next()
                     break
-                if t.text == ",":
+                if t[1] == ",":
                     self.next()
                     continue
-                if t.text == "{" or t.text == "<":
+                if t[1] == "{" or t[1] == "<":
                     # repeated-message short form: field: [{...}, {...}]
                     self.next()
                     node.add(name, self.parse_message(
-                        "}" if t.text == "{" else ">"))
+                        "}" if t[1] == "{" else ">"))
                 else:
                     node.add(name, self.parse_scalar())
             return
@@ -303,32 +304,33 @@ class _Parser:
 
     def parse_scalar(self) -> Any:
         tok = self.next()
-        if tok.kind == "string":
-            val = _unquote(tok.text)
+        kind, text = tok[0], tok[1]
+        if kind == "string":
+            val = _unquote(text)
             # adjacent string literals concatenate (C-style)
-            while (nxt := self.peek()) is not None and nxt.kind == "string":
-                val += _unquote(self.next().text)
+            while (nxt := self.peek()) is not None and nxt[0] == "string":
+                val += _unquote(self.next()[1])
             return val
-        if tok.kind == "number":
-            return _parse_number(tok.text)
-        if tok.kind == "ident":
-            if tok.text == "true":
+        if kind == "number":
+            return _parse_number(text)
+        if kind == "ident":
+            if text == "true":
                 return True
-            if tok.text == "false":
+            if text == "false":
                 return False
-            if tok.text.lower() in ("inf", "infinity"):
+            if text.lower() in ("inf", "infinity"):
                 return math.inf
-            if tok.text.lower() == "nan":
+            if text.lower() == "nan":
                 return math.nan
-            return PbEnum(tok.text)
+            return PbEnum(text)
         raise PrototxtError(
-            f"line {tok.line}:{tok.col}: expected value, got {tok.text!r}"
+            f"{_loc(self.src, tok[2])}: expected value, got {text!r}"
         )
 
 
 def parse(text: str) -> PbNode:
     """Parse prototxt text into an untyped PbNode tree."""
-    return _Parser(_tokenize(text)).parse_message(None)
+    return _Parser(_tokenize(text), text).parse_message(None)
 
 
 def parse_file(path: str) -> PbNode:

@@ -253,6 +253,14 @@ def _migrate_v1_blob_multipliers(lp: LayerParameter) -> None:
         if i < len(wds):
             spec.decay_mult = float(wds[i])  # lint: ok(host-sync) — ditto
         lp.param.append(spec)
+    # consume the node fields so a second normalize_net over the same
+    # object (netlint analyzes one parse for both phases) does not
+    # misread its own migration as "mixes legacy and modern specs"
+    node.fields.pop("blobs_lr", None)
+    node.fields.pop("weight_decay", None)
+    for name in ("blobs_lr", "weight_decay"):
+        if hasattr(lp, "_unknown") and name in lp._unknown:
+            lp._unknown.remove(name)
 
 
 def state_meets_rule(state: NetState, rule: NetStateRule) -> bool:
@@ -292,5 +300,8 @@ def filter_net(net: NetParameter, state: NetState) -> NetParameter:
     filtered.layer = [lp for lp in net.layer if layer_included(lp, state)]
     if hasattr(net, "_node"):
         filtered._node = net._node  # preserve presence info
-        filtered._unknown = getattr(net, "_unknown", [])
+        if getattr(net, "_unknown", None) is not None:
+            # copy only a COMPUTED cache — unknown_fields is lazy now,
+            # and seeding [] here would mask real unknown fields
+            filtered._unknown = net._unknown
     return filtered

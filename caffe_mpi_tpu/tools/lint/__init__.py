@@ -67,6 +67,10 @@ def extract_waivers(src: str) -> dict[int, set[str]]:
     waiver — text that merely *mentions* the grammar must not suppress
     a finding on its statement."""
     waivers: dict[int, set[str]] = {}
+    if "lint:" not in src and "host-sync:" not in src:
+        # fast path: no waiver grammar anywhere — skip the tokenizer
+        # (it dominated the whole-tree run; most files carry no waiver)
+        return waivers
     try:
         tokens = tokenize.generate_tokens(io.StringIO(src).readline)
         comments = [(t.start[0], t.string) for t in tokens
@@ -237,7 +241,7 @@ def register(cls: type[LintPass]) -> type[LintPass]:
 def _load_passes() -> None:
     # import for side effect: each module registers its pass(es)
     from . import (concrete_init, concurrency, doc_drift,  # noqa: F401
-                   gated_imports, host_sync, knob_drift,
+                   gated_imports, host_sync, knob_drift, netlint,
                    reference_citation, traced_flow)
 
 
@@ -473,13 +477,38 @@ def main(argv: list[str] | None = None) -> int:
         # deleted files appear in the diff but no longer exist; new
         # UNTRACKED files never appear — document, don't guess
         paths.extend(p for p in changed if os.path.exists(p))
+        # model edits (ISSUE 15): a changed prototxt under models/ or
+        # examples/, or the zoo generator itself, triggers the net-*
+        # passes — whole-model-tree (they are whole-tree passes, and
+        # the per-run analysis cache keeps that cheap), which covers
+        # the affected models a fortiori
+        from .netlint import MODEL_SCAN, NET_PASSES
+        model_dirs = tuple(d + "/" for d in MODEL_SCAN)
+        model_changed = [
+            rel for rel in (line.strip()
+                            for line in proc.stdout.splitlines())
+            if (rel.endswith(".prototxt") and rel.startswith(model_dirs))
+            or rel == "models/generate_models.py"]
+        if not paths and model_changed:
+            # prototxt-only change: run just the net-* family over no
+            # .py files at all (unless the user already narrowed with
+            # --select) — the passes scan the model tree themselves
+            if select is None:
+                select = list(NET_PASSES)
+            try:
+                findings = run_lint([], select=select, root=root)
+            except ValueError as e:
+                print(e.args[0], file=sys.stderr)
+                return 2
+            return _emit(findings, root, args.as_json)
         if not paths:
             # the --json contract promises a JSON array on stdout even
             # on this fast path — prose goes to stderr
             if args.as_json:
                 print("[]")
-            print("lint --changed: no changed python files in the "
-                  "scanned tree (" + ", ".join(DEFAULT_SCAN) + ")",
+            print("lint --changed: no changed python or model files in "
+                  "the scanned tree (" + ", ".join(DEFAULT_SCAN)
+                  + ", " + ", ".join(MODEL_SCAN) + ")",
                   file=sys.stderr)
             return 0
     try:
@@ -488,7 +517,11 @@ def main(argv: list[str] | None = None) -> int:
     except (ValueError, FileNotFoundError) as e:
         print(e.args[0], file=sys.stderr)
         return 2
-    if args.as_json:
+    return _emit(findings, root, args.as_json)
+
+
+def _emit(findings: list[Finding], root: str, as_json: bool) -> int:
+    if as_json:
         print(json.dumps([f.as_dict(root) for f in findings], indent=1))
     else:
         for f in findings:

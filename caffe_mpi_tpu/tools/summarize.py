@@ -1,9 +1,13 @@
 """summarize — tabular net structure listing from a prototxt.
 
 Reference: tools/extra/summarize.py (concise per-layer table to check at a
-glance that the specified computation is the expected one). This version
-additionally BUILDS the net, so it reports real output shapes and
-parameter counts (the reference prints only declared fields).
+glance that the specified computation is the expected one). Earlier
+versions BUILT the net to report real shapes; since ISSUE 15 the table
+comes from the jax-free static shape engine (proto/netshape.py — the
+same records netlint and tools/mfu_analysis.py consume, cross-checked
+bitwise against the real build for the whole zoo), so summarize works
+with the tunnel dead, without jax, and without datasets: dims a Data
+layer would learn from its DB print as '?'.
 
 Usage:
     python -m caffe_mpi_tpu.tools.summarize NET.prototxt [-phase TRAIN|TEST]
@@ -12,8 +16,11 @@ Usage:
 from __future__ import annotations
 
 import argparse
-import math
 import sys
+
+
+def _fmt_bytes(n) -> str:
+    return "-" if not n else f"{n / 2**20:.1f}"
 
 
 def main(argv=None) -> int:
@@ -23,29 +30,40 @@ def main(argv=None) -> int:
                    choices=["TRAIN", "TEST"])
     args = p.parse_args(argv)
 
-    from ..net import Net
     from ..proto import NetParameter
-    from ..utils.flops import layer_macs_per_image
+    from ..proto.netshape import _fmt, analyze_net, layer_footprint
 
-    net = Net(NetParameter.from_file(args.model), phase=args.phase)
+    analysis = analyze_net(NetParameter.from_file(args.model),
+                           phase=args.phase)
     total_params = 0
     total_macs = 0
+    total_fwd = 0
+    total_bwd = 0
     print(f"{'layer':<28}{'type':<18}{'top shape':<22}"
-          f"{'params':>12}{'MMACs/img':>12}")
-    for layer in net.layers:
-        shape = ("x".join(str(d) for d in layer.out_shapes[0])
-                 if layer.out_shapes else "-")
-        n_params = sum(math.prod(d.shape) for d in layer.params.values())
-        macs = layer_macs_per_image(layer)
+          f"{'params':>12}{'MMACs/img':>12}{'fwd MiB':>10}{'bwd MiB':>10}")
+    for info in analysis.layers:
+        shape = _fmt(info.out_shapes[0]) if info.out_shapes else "-"
+        fp = layer_footprint(info)
+        n_params = fp["param_count"] or 0
+        macs = fp["macs"]
         total_params += n_params
-        total_macs += macs
-        print(f"{layer.name:<28}{layer.lp.type:<18}{shape:<22}"
+        total_macs += macs or 0
+        total_fwd += fp["fwd_bytes"] or 0
+        total_bwd += fp["bwd_bytes"] or 0
+        print(f"{info.name:<28}{info.type:<18}{shape:<22}"
               f"{n_params or '-':>12}"
-              f"{f'{macs / 1e6:.1f}' if macs else '-':>12}")
-    print(f"\n{len(net.layers)} layers | {total_params:,} params "
+              f"{f'{macs / 1e6:.1f}' if macs else '-':>12}"
+              f"{_fmt_bytes(fp['fwd_bytes']):>10}"
+              f"{_fmt_bytes(fp['bwd_bytes']):>10}")
+    for prob in analysis.problems:
+        print(f"!! {prob.layer}: [{prob.kind}] {prob.message}",
+              file=sys.stderr)
+    print(f"\n{len(analysis.layers)} layers | {total_params:,} params "
           f"({total_params * 4 / 2**20:.1f} MiB f32) | "
-          f"{2 * total_macs / 1e9:.2f} GFLOPs/img forward")
-    return 0
+          f"{2 * total_macs / 1e9:.2f} GFLOPs/img forward | "
+          f"{(total_fwd + total_bwd) / 2**20:.0f} MiB fwd+bwd "
+          "traffic/batch")
+    return 1 if analysis.problems else 0
 
 
 if __name__ == "__main__":

@@ -10,45 +10,25 @@ The count is *model* FLOPs (the textbook cost of the layers, not whatever
 the compiler executed): conv and matmul MACs only — elementwise/pool/norm
 ops are HBM-bound noise next to the MXU terms. Backward costs 2x forward
 (one matmul each for d-input and d-weight per forward matmul).
+
+The per-type MAC formulas live in proto/netshape.py (`macs_per_image`) —
+ONE spelling shared with the jax-free netlint/summarize path (ISSUE 15);
+this module adapts built Layer objects onto it for the bench tools.
 """
 
 from __future__ import annotations
 
-import math
-
 
 def layer_macs_per_image(layer) -> int:
-    """Multiply-accumulates per image/sample for one layer (0 for
-    non-MXU ops)."""
-    t = layer.type_name
-    if t == "Convolution":
-        # weight (Cout, Cin/g, kh, kw); each output position costs
-        # Cin/g*kh*kw MACs for each of Cout channels = weight.size
-        _, _, oh, ow = layer.out_shapes[0]
-        return math.prod(layer.params["weight"].shape) * oh * ow
-    if t == "Deconvolution":
-        _, _, ih, iw = layer.in_shapes[0]
-        return math.prod(layer.params["weight"].shape) * ih * iw
-    if t == "InnerProduct":
-        # with axis > 1 the matmul applies per position: (N, *lead, K) ->
-        # (N, *lead, out); MACs scale by the positions per sample
-        positions = math.prod(layer.out_shapes[0][1:-1]) \
-            if len(layer.out_shapes[0]) > 2 else 1
-        return math.prod(layer.params["weight"].shape) * positions
-    if t == "Attention":
-        # per sample: QKV proj S*3C^2 + scores S^2*C + PV S^2*C
-        # + out proj S*C^2  =  4*S*C^2 + 2*S^2*C
-        _, s, c = layer.in_shapes[0]
-        return 4 * s * c * c + 2 * s * s * c
-    if t == "MoE":
-        # per token: gate C*E + top_k expert FFNs (C*H + H*C)
-        shape = layer.in_shapes[0]
-        tokens = math.prod(shape[1:-1]) if len(shape) > 2 else 1
-        c = shape[-1]
-        e, _, h = layer.params["w1"].shape
-        k = max(layer.p.top_k, 1)
-        return tokens * (c * e + k * 2 * c * h)
-    return 0
+    """Multiply-accumulates per image/sample for one built layer (0 for
+    non-MXU ops). Delegates to the static engine's MAC model so the
+    bench/MFU accounting and the prototxt-level analysis cannot drift."""
+    from ..proto.netshape import macs_per_image
+    macs = macs_per_image(
+        layer.type_name, layer.in_shapes, layer.out_shapes,
+        {name: tuple(decl.shape) for name, decl in layer.params.items()},
+        layer.lp)
+    return int(macs or 0)
 
 
 def net_macs_per_image(net) -> int:

@@ -26,6 +26,9 @@ sys.path.insert(0, _ROOT)
 
 ORIG = """
 name: "windownet"
+# lint: ok(net-serve) — deliberately grayscale (1-channel) toy net for
+# the net-surgery walkthrough; it is never served, so declining the
+# RGB-only native ingest plan is expected
 layer { name: "in" type: "Input" top: "data"
         input_param { shape { dim: 1 dim: 1 dim: 16 dim: 16 } } }
 layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"

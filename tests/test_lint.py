@@ -22,7 +22,10 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ALL_PASSES = ("host-sync", "traced-control-flow", "concrete-init",
               "gated-imports", "reference-citation", "doc-drift",
               "knob-drift", "lock-order", "blocking-under-lock",
-              "thread-shared-mutation")
+              "thread-shared-mutation",
+              # ISSUE 15: model-level passes (tests/test_netlint.py)
+              "net-wiring", "net-shape", "net-params", "net-dtype",
+              "net-serve", "net-footprint")
 
 
 def _write(tmp_path, name, src):
@@ -50,8 +53,9 @@ def test_all_tentpole_passes_registered():
         assert name in lint.REGISTRY, name
         assert lint.REGISTRY[name].description
     # the documented suite size (CLAUDE.md / docs/static_analysis.md):
-    # exactly ten passes, nothing registered twice or forgotten
-    assert len(lint.REGISTRY) == 10, sorted(lint.REGISTRY)
+    # ten code passes + six net-* model passes, nothing registered
+    # twice or forgotten
+    assert len(lint.REGISTRY) == 16, sorted(lint.REGISTRY)
 
 
 def test_shipped_tree_is_clean_fast_and_jax_free():

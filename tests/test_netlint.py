@@ -1,0 +1,534 @@
+"""netlint (ISSUE 15): the jax-free model-graph analysis engine and the
+net-* pass family.
+
+Three contracts hold here:
+1. Engine-vs-built-net cross-check: proto/netshape.py's inferred blob
+   shapes and param declarations are BITWISE equal to what net.py
+   actually builds, for every prototxt in the model zoo, both phases —
+   the engine can never drift from what really compiles.
+2. Zoo-wide clean gate: every zoo model, every example prototxt runs
+   netlint-clean.
+3. Seeded mutations: each classic prototxt defect produces exactly its
+   expected net-* finding.
+"""
+
+import glob
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from caffe_mpi_tpu.proto import NetParameter
+from caffe_mpi_tpu.proto.netshape import (
+    BF16_ELIGIBLE,
+    BF16_INELIGIBLE,
+    RULES,
+    analyze_net,
+    layer_footprint,
+    macs_per_image,
+)
+from caffe_mpi_tpu.tools import lint
+from caffe_mpi_tpu.tools.lint.netlint import NET_PASSES
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ZOO_NETS = sorted(
+    f for f in glob.glob(os.path.join(_ROOT, "models", "*", "*.prototxt"))
+    if "solver" not in os.path.basename(f))
+
+
+def _run_net_passes(root, select=NET_PASSES):
+    return lint.run_lint(paths=[], select=list(select), root=str(root))
+
+
+def _write_net(tmp_path, body, name="models/fixture/net.prototxt"):
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(body))
+    return p
+
+
+# ---------------------------------------------------------------------------
+# engine <-> built net cross-check (acceptance criterion)
+
+def test_zoo_has_the_expected_models():
+    dirs = {os.path.basename(os.path.dirname(f)) for f in ZOO_NETS}
+    # every zoo model dir is cross-checked (16 dirs, 40 net files incl.
+    # fp16 / pipeline / sequence-parallel variants)
+    assert len(dirs) >= 16, sorted(dirs)
+    assert "transformer_lm" in dirs and "inception_v3" in dirs
+
+
+@pytest.mark.parametrize("path", ZOO_NETS,
+                         ids=[os.path.relpath(f, _ROOT) for f in ZOO_NETS])
+@pytest.mark.parametrize("phase", ["TRAIN", "TEST"])
+def test_engine_matches_built_net(path, phase):
+    """Inferred shapes bitwise-equal to the real Net build (net.py) —
+    out shapes, blob table, and param declarations, layer for layer."""
+    from caffe_mpi_tpu.net import Net
+
+    net = Net(NetParameter.from_file(path), phase=phase, model_dir=_ROOT)
+    analysis = analyze_net(NetParameter.from_file(path), phase=phase)
+    assert [l.name for l in net.layers] == [l.name for l in analysis.layers]
+    assert not analysis.problems, analysis.problems
+    for built, inferred in zip(net.layers, analysis.layers):
+        assert [tuple(s) for s in built.out_shapes] == \
+            [tuple(s) for s in inferred.out_shapes], built.name
+        assert {n: tuple(d.shape) for n, d in built.params.items()} == \
+            {n: p.shape for n, p in inferred.params.items()}, built.name
+        # param multipliers resolve positionally the same way
+        for n, d in built.params.items():
+            assert (d.lr_mult, d.decay_mult) == (
+                inferred.params[n].lr_mult,
+                inferred.params[n].decay_mult), (built.name, n)
+    assert {k: tuple(v) for k, v in net.blob_shapes.items()} == \
+        {k: tuple(v) for k, v in analysis.blob_shapes.items()}
+    # the MAC model agrees between the built-layer adapter
+    # (utils/flops.py) and the static records
+    from caffe_mpi_tpu.utils.flops import layer_macs_per_image
+    for built, inferred in zip(net.layers, analysis.layers):
+        static = macs_per_image(
+            inferred.type, inferred.in_shapes, inferred.out_shapes,
+            {n: p.shape for n, p in inferred.params.items()}, inferred.lp)
+        assert layer_macs_per_image(built) == int(static or 0), built.name
+
+
+def test_rules_cover_layer_registry():
+    """Every registered layer type has a shape rule and vice versa — a
+    new layer cannot ship without static inference."""
+    from caffe_mpi_tpu.layers import LAYER_REGISTRY
+    assert set(RULES) == set(LAYER_REGISTRY), \
+        set(RULES) ^ set(LAYER_REGISTRY)
+
+
+def test_bf16_registry_is_exhaustive_and_disjoint():
+    """The bf16-eligibility registry (shared by net.py's build warning
+    and the net-dtype pass) classifies every layer type exactly once."""
+    from caffe_mpi_tpu.layers import LAYER_REGISTRY
+    assert BF16_ELIGIBLE | BF16_INELIGIBLE == set(LAYER_REGISTRY), \
+        (BF16_ELIGIBLE | BF16_INELIGIBLE) ^ set(LAYER_REGISTRY)
+    assert not (BF16_ELIGIBLE & BF16_INELIGIBLE)
+
+
+# ---------------------------------------------------------------------------
+# zoo-wide clean gate (acceptance criterion)
+
+def test_zoo_and_examples_are_netlint_clean():
+    findings = _run_net_passes(_ROOT)
+    assert findings == [], "\n".join(f.format(_ROOT) for f in findings)
+
+
+def test_netlint_registered_and_listed():
+    lint._load_passes()
+    for name in NET_PASSES:
+        assert name in lint.REGISTRY, name
+        assert lint.REGISTRY[name].description
+
+
+# ---------------------------------------------------------------------------
+# seeded mutations: each produces exactly its expected finding
+
+_INPUT_2 = """
+    layer { name: "in" type: "Input" top: "data" top: "label"
+            input_param { shape { dim: 8 dim: 3 dim: 8 dim: 8 }
+                          shape { dim: 8 } } }
+"""
+
+MUTATIONS = [
+    ("swapped_bottoms", "net-shape", _INPUT_2 + """
+    layer { name: "fc" type: "InnerProduct" bottom: "data" top: "fc"
+            inner_product_param { num_output: 4 } }
+    layer { name: "loss" type: "SoftmaxWithLoss"
+            bottom: "label" bottom: "fc" top: "loss" }
+    """),
+    ("bn_blob_count_off_by_one", "net-params", _INPUT_2 + """
+    layer { name: "bn" type: "BatchNorm" bottom: "data" top: "bn"
+            param { lr_mult: 0 } param { lr_mult: 0 } param { lr_mult: 0 }
+            batch_norm_param { eps: 1e-4 } }
+    """),
+    ("pad_ge_kernel", "net-shape", _INPUT_2 + """
+    layer { name: "pool" type: "Pooling" bottom: "data" top: "pool"
+            pooling_param { pool: MAX kernel_size: 2 stride: 2 pad: 2 } }
+    """),
+    ("bf16_on_ineligible_layer", "net-dtype", """
+    default_forward_type: FLOAT16
+    default_backward_type: FLOAT16
+    """ + _INPUT_2 + """
+    layer { name: "py" type: "Python" bottom: "data" top: "py"
+            python_param { module: "mymod" layer: "MyLayer" } }
+    """),
+    ("dangling_bottom", "net-wiring", _INPUT_2 + """
+    layer { name: "fc" type: "InnerProduct" bottom: "dta" top: "fc"
+            inner_product_param { num_output: 4 } }
+    """),
+    ("duplicate_top", "net-wiring", _INPUT_2 + """
+    layer { name: "fc1" type: "InnerProduct" bottom: "data" top: "fc"
+            inner_product_param { num_output: 4 } }
+    layer { name: "fc2" type: "InnerProduct" bottom: "data" top: "fc"
+            inner_product_param { num_output: 4 } }
+    """),
+    ("inplace_on_multi_consumer_blob", "net-wiring", _INPUT_2 + """
+    layer { name: "branch" type: "InnerProduct" bottom: "data" top: "b"
+            inner_product_param { num_output: 4 } }
+    layer { name: "relu" type: "ReLU" bottom: "data" top: "data" }
+    """),
+    ("inplace_shape_change", "net-wiring", _INPUT_2 + """
+    layer { name: "conv" type: "Convolution" bottom: "data" top: "data"
+            convolution_param { num_output: 4 kernel_size: 3 } }
+    """),
+    ("unreachable_layer", "net-wiring", _INPUT_2 + """
+    layer { name: "fc" type: "InnerProduct" bottom: "data" top: "fc"
+            inner_product_param { num_output: 4 }
+            exclude { phase: TRAIN } exclude { phase: TEST } }
+    """),
+    ("eltwise_shape_mismatch", "net-shape", _INPUT_2 + """
+    layer { name: "fc" type: "InnerProduct" bottom: "data" top: "fc"
+            inner_product_param { num_output: 4 } }
+    layer { name: "sum" type: "Eltwise" bottom: "data" bottom: "fc"
+            top: "sum" }
+    """),
+    ("reshape_count_mismatch", "net-shape", _INPUT_2 + """
+    layer { name: "rs" type: "Reshape" bottom: "data" top: "rs"
+            reshape_param { shape { dim: 0 dim: 5 dim: 8 dim: 8 } } }
+    """),
+    ("phase_inconsistent_include", "net-wiring", """
+    layer { name: "in" type: "Input" top: "data"
+            include { phase: TEST }
+            input_param { shape { dim: 8 dim: 3 dim: 8 dim: 8 } } }
+    layer { name: "fc" type: "InnerProduct" bottom: "data" top: "fc"
+            inner_product_param { num_output: 4 } }
+    """),
+    ("batch_baked_reshape_in_deploy", "net-serve", """
+    layer { name: "in" type: "Input" top: "data"
+            input_param { shape { dim: 8 dim: 3 dim: 8 dim: 8 } } }
+    layer { name: "rs" type: "Reshape" bottom: "data" top: "rs"
+            reshape_param { shape { dim: 8 dim: 192 } } }
+    """),
+    ("non_rgb_image_deploy", "net-serve", """
+    layer { name: "in" type: "Input" top: "data"
+            input_param { shape { dim: 8 dim: 4 dim: 16 dim: 16 } } }
+    layer { name: "fc" type: "InnerProduct" bottom: "data" top: "fc"
+            inner_product_param { num_output: 4 } }
+    """),
+    ("hbm_blowout_blob", "net-footprint", """
+    layer { name: "in" type: "Input" top: "data"
+            input_param { shape { dim: 4096 dim: 3 dim: 22700 dim: 22700 } } }
+    """),
+]
+
+
+@pytest.mark.parametrize("name,expected,body", MUTATIONS,
+                         ids=[m[0] for m in MUTATIONS])
+def test_seeded_mutation_caught(tmp_path, name, expected, body):
+    _write_net(tmp_path, 'name: "fixture"\n' + body)
+    findings = _run_net_passes(tmp_path)
+    assert findings, f"{name}: no findings"
+    got = {f.pass_name for f in findings}
+    assert got == {expected}, \
+        f"{name}: expected only {expected}, got " + \
+        "\n".join(f.format(str(tmp_path)) for f in findings)
+
+
+def test_clean_fixture_is_clean(tmp_path):
+    _write_net(tmp_path, 'name: "ok"\n' + _INPUT_2 + """
+    layer { name: "fc" type: "InnerProduct" bottom: "data" top: "fc"
+            inner_product_param { num_output: 4 } }
+    layer { name: "loss" type: "SoftmaxWithLoss"
+            bottom: "fc" bottom: "label" top: "loss" }
+    """)
+    assert _run_net_passes(tmp_path) == []
+
+
+def test_malformed_prototxt_is_a_wiring_finding(tmp_path):
+    _write_net(tmp_path, 'layer { name: "x" type: ??? }')
+    findings = _run_net_passes(tmp_path)
+    assert [f.pass_name for f in findings] == ["net-wiring"]
+    assert "parse" in findings[0].message
+
+
+def test_missing_bottom_is_a_finding_not_a_crash(tmp_path):
+    """A layer omitting a required bottom must produce a net-wiring
+    finding — not an IndexError that aborts the whole-tree lint."""
+    _write_net(tmp_path, 'name: "f"\n' + _INPUT_2 + """
+    layer { name: "r" type: "ReLU" top: "x" }
+    """)
+    findings = _run_net_passes(tmp_path)
+    assert findings and {f.pass_name for f in findings} == {"net-wiring"}
+
+
+def test_zero_stride_is_a_finding_not_a_crash(tmp_path):
+    _write_net(tmp_path, 'name: "f"\n' + _INPUT_2 + """
+    layer { name: "c" type: "Convolution" bottom: "data" top: "c"
+            convolution_param { num_output: 4 kernel_size: 3 stride: 0 } }
+    layer { name: "p" type: "Pooling" bottom: "data" top: "p"
+            pooling_param { pool: MAX kernel_size: 2 stride: 0 } }
+    layer { name: "d" type: "Convolution" bottom: "data" top: "d"
+            convolution_param { num_output: 4 kernel_size: 3
+                                dilation: 1 dilation: 1 dilation: 1 } }
+    """)
+    findings = _run_net_passes(tmp_path)
+    assert findings and {f.pass_name for f in findings} == {"net-shape"}
+    assert sum("stride" in f.message for f in findings) == 2
+    assert any("dilation" in f.message for f in findings)
+
+
+def test_colon_message_form_net_is_scanned(tmp_path):
+    """The text format accepts `layer: { ... }`; the solver prefilter
+    must not misread that spelling as a solver file."""
+    _write_net(tmp_path, 'name: "f"\n' + """
+    layer: { name: "in" type: "Input" top: "data"
+             input_param { shape { dim: 8 dim: 3 dim: 8 dim: 8 } } }
+    layer: { name: "fc" type: "InnerProduct" bottom: "nosuch" top: "fc"
+             inner_product_param { num_output: 4 } }
+    """)
+    findings = _run_net_passes(tmp_path)
+    assert findings and any(
+        f.pass_name == "net-wiring" and "nosuch" in f.message
+        for f in findings)
+
+
+def test_deploy_pipeline_with_dropout_is_not_flagged(tmp_path):
+    """The Dropout-in-Pipeline rule is TRAIN-only; a deploy-shaped net
+    (no phase rules) must not inherit it from the shared-analysis fast
+    path."""
+    _write_net(tmp_path, 'name: "pp"\n' + """
+    layer { name: "in" type: "Input" top: "x"
+            input_param { shape { dim: 4 dim: 8 dim: 16 } } }
+    layer { name: "trunk" type: "Pipeline" bottom: "x" top: "y"
+            pipeline_param { num_stages: 2 micro_batches: 2
+              layer { name: "ln" type: "LayerNorm" bottom: "x" top: "n" }
+              layer { name: "do" type: "Dropout" bottom: "n" top: "n2" }
+              layer { name: "res" type: "Eltwise" bottom: "x"
+                      bottom: "n2" top: "out" } } }
+    """)
+    findings = _run_net_passes(tmp_path)
+    assert not any("Dropout" in f.message for f in findings), \
+        "\n".join(f.format(str(tmp_path)) for f in findings)
+    # ...while a TRAIN net (phase-ruled, so analyzed per phase) with the
+    # same block is flagged, tagged to TRAIN
+    _write_net(tmp_path, 'name: "pp2"\n' + """
+    layer { name: "in" type: "Input" top: "x"
+            include { phase: TRAIN }
+            input_param { shape { dim: 4 dim: 8 dim: 16 } } }
+    layer { name: "in" type: "Input" top: "x"
+            include { phase: TEST }
+            input_param { shape { dim: 4 dim: 8 dim: 16 } } }
+    layer { name: "trunk" type: "Pipeline" bottom: "x" top: "y"
+            pipeline_param { num_stages: 2 micro_batches: 2
+              layer { name: "ln" type: "LayerNorm" bottom: "x" top: "n" }
+              layer { name: "do" type: "Dropout" bottom: "n" top: "n2" }
+              layer { name: "res" type: "Eltwise" bottom: "x"
+                      bottom: "n2" top: "out" } } }
+    """, name="models/fixture2/net.prototxt")
+    findings = [f for f in _run_net_passes(tmp_path)
+                if "fixture2" in f.path]
+    assert any("Dropout" in f.message and "[phase TRAIN]" in f.message
+               for f in findings), \
+        "\n".join(f.format(str(tmp_path)) for f in findings)
+
+
+def test_distinct_unnamed_layers_report_distinctly(tmp_path):
+    _write_net(tmp_path, 'name: "anon"\n' + """
+    layer { type: "Input" top: "a" }
+    layer { type: "Input" top: "b" }
+    """)
+    findings = [f for f in _run_net_passes(tmp_path)
+                if "input_param.shape required" in f.message]
+    assert len(findings) == 2, \
+        "\n".join(f.format(str(tmp_path)) for f in findings)
+    assert {f.message.split(":")[0] for f in findings} == \
+        {"layer #0 (unnamed)", "layer #1 (unnamed)"}
+
+
+def test_single_quoted_hash_does_not_corrupt_spans_or_waivers(tmp_path):
+    """text_format accepts single-quoted strings; a '#' inside one must
+    not read as a comment (span corruption / waiver leakage)."""
+    body = """
+    layer { name: "in" type: "Input" top: "data"
+            input_param { shape { dim: 8 dim: 4 dim: 16 dim: 16 } } }
+    layer { name: "h5" type: "HDF5Output" bottom: "data"
+            hdf5_output_param { file_name: '/data/#shard1.h5' } }
+    layer { name: "fc" type: "InnerProduct" bottom: "data" top: "fc"
+            inner_product_param { num_output: 4 } }
+    """
+    from caffe_mpi_tpu.tools.lint.netlint import _layer_spans
+    spans = _layer_spans(('name: "q"\n' + body).splitlines())
+    assert len(spans) == 3 and [n for n, _s, _e in spans] == \
+        ["in", "h5", "fc"]
+    _write_net(tmp_path, 'name: "q"\n' + body)
+    findings = _run_net_passes(tmp_path)
+    # the only finding is the net-serve C=4 one, anchored to 'in' —
+    # not suppressed or displaced by the quoted '#'
+    assert [f.pass_name for f in findings] == ["net-serve"]
+
+
+def test_legacy_v1_net_analyzes_clean_for_both_phases(tmp_path):
+    """normalize_net must be idempotent: netlint analyzes ONE parse for
+    TRAIN and TEST, and the V1 blobs_lr migration used to misread its
+    own output as 'mixes legacy and modern specs' on the second pass."""
+    _write_net(tmp_path, 'name: "legacy"\n' + """
+    layer { name: "in" type: "Input" top: "data" top: "label"
+            include { phase: TRAIN }
+            input_param { shape { dim: 8 dim: 3 dim: 8 dim: 8 }
+                          shape { dim: 8 } } }
+    layer { name: "in" type: "Input" top: "data" top: "label"
+            include { phase: TEST }
+            input_param { shape { dim: 8 dim: 3 dim: 8 dim: 8 }
+                          shape { dim: 8 } } }
+    layer { name: "fc" type: "InnerProduct" bottom: "data" top: "fc"
+            blobs_lr: 1 blobs_lr: 2
+            inner_product_param { num_output: 4 } }
+    layer { name: "loss" type: "SoftmaxWithLoss"
+            bottom: "fc" bottom: "label" top: "loss" }
+    """)
+    assert _run_net_passes(tmp_path) == []
+
+
+def test_solver_prototxts_are_skipped(tmp_path):
+    _write_net(tmp_path, 'net: "train.prototxt"\nbase_lr: 0.01\n',
+               name="models/fixture/solver.prototxt")
+    assert _run_net_passes(tmp_path) == []
+
+
+# ---------------------------------------------------------------------------
+# prototxt waiver grammar (satellite: per-layer waiver or generated
+# registry)
+
+_NON_RGB = """
+    layer { name: "in" type: "Input" top: "data"
+            input_param { shape { dim: 8 dim: 4 dim: 16 dim: 16 } } }
+    layer { name: "fc" type: "InnerProduct" bottom: "data" top: "fc"
+            inner_product_param { num_output: 4 } }
+"""
+
+
+def test_prototxt_waiver_inside_layer_block(tmp_path):
+    body = _NON_RGB.replace(
+        'top: "data"',
+        'top: "data"  # lint: ok(net-serve) — grayscale+alpha by design')
+    _write_net(tmp_path, 'name: "w"\n' + body)
+    assert _run_net_passes(tmp_path) == []
+
+
+def test_prototxt_waiver_in_comment_block_above(tmp_path):
+    body = _NON_RGB.replace(
+        'layer { name: "in"',
+        '# lint: ok(net-serve) — grayscale+alpha by design\n'
+        '    layer { name: "in"')
+    _write_net(tmp_path, 'name: "w"\n' + body)
+    assert _run_net_passes(tmp_path) == []
+
+
+def test_prototxt_waiver_on_other_layer_does_not_suppress(tmp_path):
+    body = _NON_RGB.replace(
+        'top: "fc"',
+        'top: "fc"  # lint: ok(net-serve) — wrong layer')
+    _write_net(tmp_path, 'name: "w"\n' + body)
+    findings = _run_net_passes(tmp_path)
+    assert [f.pass_name for f in findings] == ["net-serve"]
+
+
+def test_generated_waiver_registry(tmp_path, monkeypatch):
+    from caffe_mpi_tpu.tools.lint import netlint
+    _write_net(tmp_path, 'name: "w"\n' + _NON_RGB)
+    monkeypatch.setitem(
+        netlint.GENERATED_WAIVERS,
+        (os.path.join("models", "fixture", "net.prototxt"),
+         "net-serve", "in"),
+        "generated model, grayscale+alpha by design")
+    assert _run_net_passes(tmp_path) == []
+
+
+def test_misspelled_prototxt_waiver_is_a_finding(tmp_path):
+    _write_net(tmp_path, 'name: "w"\n' + _INPUT_2 + """
+    # lint: ok(net-sreve) — typo'd pass name must fail, not suppress
+    layer { name: "fc" type: "InnerProduct" bottom: "data" top: "fc"
+            inner_product_param { num_output: 4 } }
+    """)
+    findings = _run_net_passes(tmp_path)
+    assert [f.pass_name for f in findings] == ["net-wiring"]
+    assert "unknown pass" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# --changed learns about model files (satellite)
+
+def test_changed_mode_prototxt_triggers_net_passes(tmp_path, monkeypatch):
+    """A diff containing only a prototxt used to exit 0 without looking
+    at models at all; now it runs the net-* family."""
+    import subprocess as sp
+    real_run = sp.run
+
+    def fake_run(cmd, **kw):
+        if cmd[:3] == ["git", "diff", "--name-only"]:
+            class R:
+                returncode = 0
+                stdout = "models/alexnet/train_val.prototxt\n"
+                stderr = ""
+            return R()
+        return real_run(cmd, **kw)
+
+    monkeypatch.setattr(sp, "run", fake_run)
+    # the real tree is clean -> exit 0, but via the net-pass path (a
+    # seeded broken zoo would exit 1; proven by the fixture variant
+    # below through run_lint)
+    assert lint.main(["--changed", "HEAD", "--no-stale"]) == 0
+
+
+def test_changed_mode_generator_edit_triggers_net_passes(monkeypatch):
+    import subprocess as sp
+    real_run = sp.run
+
+    def fake_run(cmd, **kw):
+        if cmd[:3] == ["git", "diff", "--name-only"]:
+            class R:
+                returncode = 0
+                stdout = "models/generate_models.py\n"
+                stderr = ""
+            return R()
+        return real_run(cmd, **kw)
+
+    monkeypatch.setattr(sp, "run", fake_run)
+    assert lint.main(["--changed", "HEAD", "--no-stale"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# summarize rides the same engine, jax-free
+
+def test_summarize_is_jax_free_and_reports_totals():
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import sys\n"
+         "for m in ('jax', 'jaxlib'):\n"
+         "    sys.modules[m] = None\n"
+         "from caffe_mpi_tpu.tools.summarize import main\n"
+         "raise SystemExit(main(['models/alexnet/train_val.prototxt']))"],
+        cwd=_ROOT, capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, PYTHONPATH=_ROOT))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "60,965,224 params" in r.stdout
+    assert "MMACs/img" in r.stdout and "bwd MiB" in r.stdout
+
+
+def test_summarize_surfaces_problems_and_exits_nonzero(tmp_path):
+    p = _write_net(tmp_path, 'name: "bad"\n' + _INPUT_2 + """
+    layer { name: "pool" type: "Pooling" bottom: "data" top: "pool"
+            pooling_param { pool: MAX kernel_size: 2 stride: 2 pad: 2 } }
+    """)
+    from caffe_mpi_tpu.tools.summarize import main
+    assert main([str(p)]) == 1
+
+
+def test_footprint_handles_unknown_dims():
+    analysis = analyze_net(NetParameter.from_file(
+        os.path.join(_ROOT, "examples/mnist/lenet_train_test.prototxt")),
+        phase="TRAIN")
+    assert not analysis.problems
+    conv1 = next(l for l in analysis.layers if l.name == "conv1")
+    fp = layer_footprint(conv1)
+    assert fp["macs"] is None and fp["param_count"] is None
+    # channels propagate once known: conv2's weight is fully shaped
+    conv2 = next(l for l in analysis.layers if l.name == "conv2")
+    assert layer_footprint(conv2)["param_count"] == 25050

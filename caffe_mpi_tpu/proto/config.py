@@ -1182,6 +1182,17 @@ class ServingParameter(Message):
     # CAFFE_NATIVE_DECODE=0/1 forces the PIL/native request decoder for
     # A/B runs, exactly as on the training ingest path.
     serve_decoded_cache_mb: float = 0.0
+    # persistent AOT program bank directory (ISSUE 17, docs/serving.md
+    # "Program bank"): after each bucket warm the compiled XLA
+    # executable is serialized into this directory under a fingerprint
+    # of model topology + bucket + dtype + jax/jaxlib/backend version,
+    # published verified-atomically (crc32c sidecar manifest written
+    # last). A bank-warm engine start deserializes its whole ladder
+    # with ZERO compiles (`compile_count == bank_misses`, counters in
+    # engine.stats()["bank"] /stats); any torn/rotten/stale entry is a
+    # counted miss that recompiles and repopulates, never a crash.
+    # "" (default) = bank off, today's behavior.
+    serve_program_bank: str = ""
 
 
 SOLVER_TYPE_NAMES = {

@@ -9,11 +9,12 @@ from .engine import (BucketedForward, CompileCounter, InferenceModel,
                      ServingEngine, bucket_for, plan_ladder)
 from .errors import (DeadlineError, EngineClosedError, EngineUnhealthyError,
                      ServingError, ShedError, SwapError)
+from .program_bank import BankStats, ProgramBank
 from .watch import SnapshotWatcher
 
 __all__ = [
     "BucketedForward", "CompileCounter", "InferenceModel", "ServingEngine",
-    "bucket_for", "plan_ladder",
+    "bucket_for", "plan_ladder", "BankStats", "ProgramBank",
     "ServingError", "ShedError", "DeadlineError", "EngineClosedError",
     "EngineUnhealthyError", "SwapError", "SnapshotWatcher",
 ]

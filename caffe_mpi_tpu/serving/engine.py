@@ -45,56 +45,10 @@ log = logging.getLogger(__name__)
 
 _NULL_SECTION = nullcontext()
 
-# default bucket ladder: geometric x4 growth from 1 up to the model's
-# max batch — small arrivals pay a small program, bursts fill max
-DEFAULT_LADDER_GROWTH = 4
-
-
-def plan_ladder(max_batch: int, spec=None) -> tuple[int, ...]:
-    """Plan the padded-batch bucket ladder for a model.
-
-    Returns ascending, deduplicated bucket sizes that always include
-    `max_batch` (the largest program is the burst path). `spec` pins the
-    ladder explicitly — a comma string ("1,4,16") or an iterable of
-    ints; entries above `max_batch` are clipped out (the model cannot
-    run them). None = geometric default 1, 4, 16, ... max_batch.
-    """
-    if max_batch < 1:
-        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
-    if spec is None:
-        sizes = []
-        b = 1
-        while b < max_batch:
-            sizes.append(b)
-            b *= DEFAULT_LADDER_GROWTH
-        sizes.append(max_batch)
-        return tuple(sizes)
-    if isinstance(spec, str):
-        parts = [p.strip() for p in spec.split(",") if p.strip()]
-        try:
-            spec = [int(p) for p in parts]
-        except ValueError:
-            raise ValueError(f"bad bucket ladder spec {spec!r}: expected "
-                             "comma-separated ints like '1,4,16'") from None
-    sizes = sorted(set(int(b) for b in spec))
-    if not sizes:
-        raise ValueError("empty bucket ladder spec")
-    if sizes[0] < 1:
-        raise ValueError(f"bucket sizes must be >= 1, got {sizes[0]}")
-    sizes = [b for b in sizes if b <= max_batch]
-    if not sizes or sizes[-1] != max_batch:
-        sizes.append(max_batch)
-    return tuple(sizes)
-
-
-def bucket_for(n: int, ladder: tuple[int, ...]) -> int:
-    """Smallest bucket holding n images (callers chunk at ladder[-1])."""
-    if n < 1:
-        raise ValueError(f"need at least one image, got {n}")
-    for b in ladder:
-        if b >= n:
-            return b
-    return ladder[-1]
+# ladder planning moved to the static serving plan (plan.py, ISSUE 17);
+# re-exported here so the classic import sites are unchanged
+from .plan import DEFAULT_LADDER_GROWTH, bucket_for, plan_ladder  # noqa: E402,F401
+from .program_bank import BankStats, ProgramBank, fingerprint  # noqa: E402
 
 
 class CompileCounter:
@@ -174,7 +128,9 @@ class BucketedForward:
     def __init__(self, net_param: NetParameter, *, ladder=None,
                  max_batch: int = 0, out_blob: str | None = None,
                  model_dir: str = "", counter: CompileCounter | None = None,
-                 full_env: bool = False, dtype: str = "f32"):
+                 full_env: bool = False, dtype: str = "f32",
+                 bank: ProgramBank | None = None,
+                 bank_stats: BankStats | None = None):
         self._base = copy.deepcopy(net_param)
         self._model_dir = model_dir
         # serve_dtype (ISSUE 9): "bf16" compiles every bucket program
@@ -193,6 +149,15 @@ class BucketedForward:
         self.max_batch = max_batch or declared
         self.ladder = plan_ladder(self.max_batch, ladder)
         self.counter = counter or CompileCounter()
+        # program bank (ISSUE 17): warm tries a deserialize before
+        # compiling; every real compile is counted as a bank miss even
+        # bank-off, so `compile_count == bank_misses` holds everywhere
+        self._bank = bank
+        self._bank_stats = bank_stats or (bank.stats if bank is not None
+                                          else BankStats())
+        # per-bucket warm breakdown (lower/compile/deserialize ms),
+        # appended under _lock, surfaced via engine.stats()["bank"]
+        self.warm_events: list[dict] = []
         self._nets: dict[int, Net] = {}
         self._compiled: dict[int, object] = {}
         self._out_blob = out_blob
@@ -260,7 +225,13 @@ class BucketedForward:
         return net.blob_shapes[net.feed_blobs[0]]
 
     def compile_bucket(self, bucket: int, params, state):
-        """AOT-compile this bucket's program (counted). Idempotent."""
+        """AOT-build this bucket's program (idempotent): a verified
+        program-bank entry deserializes — an UNCOUNTED compile and a
+        counted bank hit — anything else compiles fresh (counted, and
+        counted as a bank miss; with the bank off every build is a
+        miss, so `compile_count == bank_misses` holds unconditionally).
+        Each build appends a warm event with its lower/compile/
+        deserialize breakdown for the cold-start telemetry."""
         import jax
         with self._lock:
             compiled = self._compiled.get(bucket)
@@ -268,6 +239,26 @@ class BucketedForward:
                 return compiled
             net = self._net_for(bucket)
             in_blob, out = net.feed_blobs[0], self.out_blob(bucket)
+            ev = {"bucket": bucket, "source": "compile", "lower_ms": 0.0,
+                  "compile_ms": 0.0, "deserialize_ms": 0.0}
+            fp = None
+            if self._bank is not None:
+                fp = fingerprint(
+                    self._base, bucket=bucket,
+                    dtype=self._precision or "f32",
+                    out_spec="env" if self._full_env else out,
+                    runtime=self._bank.runtime())
+                t0 = time.perf_counter()
+                compiled = self._bank.load(fp)
+                ev["deserialize_ms"] = round(
+                    (time.perf_counter() - t0) * 1e3, 3)
+                if compiled is not None:
+                    ev["source"] = "bank"
+                    self.warm_events.append(ev)
+                    self._compiled[bucket] = compiled
+                    return compiled
+            else:
+                self._bank_stats.bump("misses")
 
             def fwd(p, s, feeds):
                 env, _, _ = net.apply(p, s, feeds, train=False)
@@ -282,13 +273,22 @@ class BucketedForward:
 
             feeds_struct = {in_blob: jax.ShapeDtypeStruct(
                 net.blob_shapes[in_blob], np.float32)}
+            t0 = time.perf_counter()
+            lowered = jax.jit(fwd).lower(params, state, feeds_struct)
+            t1 = time.perf_counter()
             # lint: ok(blocking-under-lock) — serializing the compile IS
             # this lock's purpose: racing warmers must not build the same
             # bucket program twice, and steady-state serving never takes
             # this path (compile_count == warmed_buckets is the invariant)
-            compiled = jax.jit(fwd).lower(params, state,
-                                          feeds_struct).compile()
+            compiled = lowered.compile()
+            ev["lower_ms"] = round((t1 - t0) * 1e3, 3)
+            ev["compile_ms"] = round((time.perf_counter() - t1) * 1e3, 3)
             self.counter.bump()
+            if fp is not None:
+                # repopulate after the counted miss, so the NEXT start
+                # is the bank-warm one (rotten entries self-heal)
+                self._bank.store(fp, compiled)
+            self.warm_events.append(ev)
             self._compiled[bucket] = compiled
             return compiled
 
@@ -353,13 +353,16 @@ class InferenceModel:
                  *, ladder=None, max_batch: int = 0, mean=None,
                  input_scale=None, raw_scale=None, channel_swap=None,
                  image_dims=None, counter: CompileCounter | None = None,
-                 model_dir: str = "", dtype: str = "f32"):
+                 model_dir: str = "", dtype: str = "f32",
+                 bank: ProgramBank | None = None,
+                 bank_stats: BankStats | None = None):
         import jax
         self.name = name
         param = NetParameter.from_file(model_file)
         self.fwd = BucketedForward(param, ladder=ladder, max_batch=max_batch,
                                    counter=counter, model_dir=model_dir,
-                                   dtype=dtype)
+                                   dtype=dtype, bank=bank,
+                                   bank_stats=bank_stats)
         params, state = self.fwd.init()
         if weights:
             from .. import io as _io
@@ -452,7 +455,10 @@ class ServingEngine:
     deadline (DeadlineError at window close instead of aging forever);
     `serve_stall_s` — dispatch stall breaker (a device call past it
     fails the in-flight futures, journals, and flips the engine
-    unhealthy so requests shed instead of hanging on a dead tunnel).
+    unhealthy so requests shed instead of hanging on a dead tunnel);
+    `serve_program_bank` (ISSUE 17) — directory of serialized bucket
+    executables: a bank-warm start deserializes its whole ladder with
+    zero compiles (`compile_count == bank_misses`), empty = off.
 
     `journal` names a prefix for the serving run journal
     (`<journal>.serve.run.json` — breaker trips, hot swaps, swap
@@ -465,6 +471,7 @@ class ServingEngine:
                  deadline_ms: float | None = None,
                  stall_s: float | None = None, journal: str | None = None,
                  decoded_cache_mb: float | None = None,
+                 program_bank: str | None = None,
                  start: bool = True):
         # AOT warms go through the persistent XLA cache: a restarted
         # server re-loads its zoo from disk hits, not fresh compiles
@@ -525,6 +532,20 @@ class ServingEngine:
                 f"unknown serve_dtype {self.serve_dtype!r} (expected "
                 "'f32' or 'bf16')")
         self.counter = CompileCounter()
+        # persistent AOT program bank (ISSUE 17): serve_program_bank
+        # names the bank directory, empty = off. The stats object lives
+        # on the ENGINE either way, so `compile_count == bank_misses`
+        # is an unconditional invariant (bank off: every warm compiles
+        # and counts a miss; bank-warm: both are zero).
+        bank_path = str(program_bank if program_bank is not None
+                        else getattr(sp, "serve_program_bank", "") or "")
+        self.bank_stats = BankStats()
+        self.bank = ProgramBank(bank_path, self.bank_stats) \
+            if bank_path else None
+        # cold-start telemetry: wall time spent in load_model (plan +
+        # init + warm + upload), summed across the zoo
+        self.cold_start_ms = 0.0
+        self._plans: OrderedDict[str, dict] = OrderedDict()  # load order
         self._models: OrderedDict[str, InferenceModel] = OrderedDict()
         self._lock = threading.RLock()
         self.spills = 0
@@ -561,11 +582,31 @@ class ServingEngine:
     # -- model zoo ------------------------------------------------------
     def load_model(self, name: str, model_file: str,
                    weights: str | None = None, **preprocess) -> InferenceModel:
-        """Load + AOT-warm a model: every ladder bucket compiles NOW, so
-        steady-state traffic of any arrival-size mix runs zero compiles."""
+        """Load + AOT-warm a model: every ladder bucket builds NOW, so
+        steady-state traffic of any arrival-size mix runs zero compiles
+        — and with a warm program bank the build itself deserializes
+        instead of compiling (zero compiles at load, ISSUE 17)."""
+        t_load = time.perf_counter()
+        # static plan FIRST, before any device (or tunnel) touch: the
+        # netshape engine prices the ladder's activation bytes and the
+        # model's param bytes jax-free (plan.py), so admission and the
+        # LRU spill order are decided while the tunnel may still be
+        # dead; planning failure must never block serving
+        plan = None
+        try:
+            from .plan import plan_model
+            plan = plan_model(
+                NetParameter.from_file(model_file),
+                ladder=self.ladder_spec,
+                max_batch=int(preprocess.get("max_batch", 0) or 0),
+                dtype=self.serve_dtype)
+        except Exception as e:  # noqa: BLE001 — plan is advisory
+            log.warning("serving: static plan for %r failed (%s); "
+                        "loading without one", name, e)
         model = InferenceModel(
             name, model_file, weights, ladder=self.ladder_spec,
-            counter=self.counter, dtype=self.serve_dtype, **preprocess)
+            counter=self.counter, dtype=self.serve_dtype,
+            bank=self.bank, bank_stats=self.bank_stats, **preprocess)
         # count the incoming ladder on the warmed side BEFORE warming:
         # warm bumps the shared counter per bucket, and a /stats poll
         # mid-load must not read compile_count > warmed_buckets as a
@@ -586,10 +627,18 @@ class ServingEngine:
             if old is not None:
                 self._retired_warmed += len(old.fwd.ladder)
             self._models[name] = model
+            if plan is not None:
+                self._plans[name] = plan
         self._make_resident(model)
-        log.info("serving: model %r loaded (%d bucket programs %s, "
-                 "%.1f MiB params)", name, len(model.fwd.ladder),
-                 model.fwd.ladder, model.param_bytes / 2**20)
+        load_ms = round((time.perf_counter() - t_load) * 1e3, 3)
+        with self._lock:
+            self.cold_start_ms += load_ms
+            if plan is not None:
+                plan["load_ms"] = load_ms
+        log.info("serving: model %r loaded in %.0f ms (%d bucket "
+                 "programs %s, %.1f MiB params)", name, load_ms,
+                 len(model.fwd.ladder), model.fwd.ladder,
+                 model.param_bytes / 2**20)
         return model
 
     def model(self, name: str) -> InferenceModel:
@@ -604,6 +653,14 @@ class ServingEngine:
     @property
     def compile_count(self) -> int:
         return self.counter.count
+
+    @property
+    def bank_hits(self) -> int:
+        return self.bank_stats.hits
+
+    @property
+    def bank_misses(self) -> int:
+        return self.bank_stats.misses
 
     @property
     def warmed_buckets(self) -> int:
@@ -822,8 +879,12 @@ class ServingEngine:
 
     def ready(self) -> tuple[bool, dict]:
         """/readyz payload: ready iff the zoo is loaded and fully
-        AOT-warmed (`compile_count == warmed_buckets`, no load in
-        flight), the breaker is closed, and the engine accepts work."""
+        AOT-warmed — every warmed bucket was either compiled or
+        deserialized from the program bank (`compile_count ==
+        bank_misses` and `compile_count + bank_hits == warmed_buckets`;
+        bank off, hits are zero and this is exactly the classic
+        `compile_count == warmed_buckets`), no load in flight, the
+        breaker closed, and the engine accepting work."""
         with self._lock:
             warming = self._pending_warm > 0
             models = len(self._models)
@@ -832,12 +893,16 @@ class ServingEngine:
             "warming": warming,
             "warmed_buckets": self.warmed_buckets,
             "compile_count": self.compile_count,
+            "bank_hits": self.bank_hits,
+            "bank_misses": self.bank_misses,
             "healthy": self._healthy,
             "closed": self._closed,
         }
         doc["ready"] = (models > 0 and not warming and not self._closed
                         and self._healthy
-                        and self.compile_count == doc["warmed_buckets"])
+                        and self.compile_count == doc["bank_misses"]
+                        and self.compile_count + doc["bank_hits"]
+                        == doc["warmed_buckets"])
         return doc["ready"], doc
 
     def _journal(self, reason: str, **extra) -> None:
@@ -1066,6 +1131,32 @@ class ServingEngine:
         self._batcher.drain(timeout)
 
     # -- telemetry ------------------------------------------------------
+    def bank_telemetry(self) -> dict:
+        """stats()["bank"]: program-bank counters, cold-start wall time,
+        per-model per-bucket warm breakdown (lower/compile/deserialize
+        ms, build source), and the netshape plan — per-model footprints
+        plus the statically simulated HBM admission in load order."""
+        from .plan import plan_admission
+        with self._lock:
+            plans = {n: dict(p) for n, p in self._plans.items()}
+            warm = {n: list(m.fwd.warm_events)
+                    for n, m in self._models.items()}
+            cold_ms = self.cold_start_ms
+        out = {
+            "enabled": self.bank is not None,
+            "path": self.bank.path if self.bank is not None else "",
+            "cold_start_ms": round(cold_ms, 3),
+            "warm": warm,
+            "plan": {
+                "models": plans,
+                "admission": plan_admission(
+                    [(n, p.get("param_bytes", 0))
+                     for n, p in plans.items()], self.hbm_budget),
+            },
+        }
+        out.update(self.bank_stats.snapshot())
+        return out
+
     def stats(self) -> dict:
         """Serving telemetry: p50/p99 end-to-end latency, sustained
         img/s, dispatch fill, and the zero-recompile counters."""
@@ -1094,6 +1185,9 @@ class ServingEngine:
             # request-ingest plane (ISSUE 14): decode-path engagement,
             # window-fused preprocess counters, hot-content cache
             "ingest": self.ingest.stats(),
+            # program bank + static plan (ISSUE 17): hit/miss/verify
+            # counters, per-bucket warm breakdown, netshape admission
+            "bank": self.bank_telemetry(),
         }
         if recs:
             lat = np.sort(np.array([r["total_ms"] for r in recs]))

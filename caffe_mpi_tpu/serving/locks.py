@@ -58,6 +58,17 @@ LOCK_ORDER: tuple[tuple[str, str], ...] = (
     ("ServingEngine._probe_lock", "resilience._RUN_MANIFEST_LOCK"),
     # compile_bucket counts its compile while serializing the warm path.
     ("BucketedForward._lock", "CompileCounter._lock"),
+    # the program bank (ISSUE 17) loads/stores entries inside the same
+    # warm serialization: bank counters bump under BankStats._lock, and
+    # store() serializes same-process writers across engines with the
+    # module-level write lock (atomic_output temp names key on pid, so
+    # unserialized in-process writers would sweep each other's temps).
+    ("BucketedForward._lock", "BankStats._lock"),
+    ("BucketedForward._lock", "program_bank._WRITE_LOCK"),
+    # store() counts a failed publish while still serializing writers:
+    # bump() holds BankStats._lock for six attribute increments and
+    # never blocks or takes further locks, so the nesting is one-way.
+    ("program_bank._WRITE_LOCK", "BankStats._lock"),
 )
 
 # Cross-object attribute types the AST cannot infer (constructor
@@ -67,4 +78,9 @@ LOCK_ORDER: tuple[tuple[str, str], ...] = (
 ATTR_TYPES: dict[str, str] = {
     "Batcher._engine": "ServingEngine",
     "BucketedForward.counter": "CompileCounter",
+    "BucketedForward._bank": "ProgramBank",
+    "BucketedForward._bank_stats": "BankStats",
+    "ProgramBank.stats": "BankStats",
+    "ServingEngine.bank": "ProgramBank",
+    "ServingEngine.bank_stats": "BankStats",
 }

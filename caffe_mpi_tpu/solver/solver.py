@@ -924,11 +924,32 @@ class Solver:
 
         return step
 
+    def _train_donate_argnums(self) -> tuple[int, ...]:
+        """Donate (params, net_state, opt_state) into the train program —
+        on accelerators. On the CPU host platform donation is disabled:
+        the 0.4.37 CPU client intermittently corrupts donated train
+        state when several dispatches are in flight (reproduced ~50% on
+        the 8-virtual-device client as a resumed `-train_guard` run
+        whose replayed weights differ run-to-run; any host sync between
+        dispatches — display, per-iteration snapshots — masks it, and
+        dropping donation alone eliminates it over dozens of trials).
+        Same buffer-handoff hazard family as the async-snapshot SIGABRT
+        (docs/crash_hunt_r5.md), one layer deeper. Donation never
+        changes numerics — only buffer reuse — so CPU test runs stay
+        bitwise identical to donating builds; on TPU the donation is
+        load-bearing (params + momentum would otherwise double their
+        HBM footprint) and the tunnel's per-dispatch RTT serializes
+        dispatch handoffs anyway."""
+        if jax.default_backend() == "cpu":
+            return ()
+        return (0, 1, 2)
+
     def _build_step(self):
         # the guard carry (5 scalars) is NOT donated: the deferred
         # divergence check reads the previous dispatch's gstate after
         # the next one launches, so its buffer must stay valid
-        return jax.jit(self._iteration_fn(), donate_argnums=(0, 1, 2))
+        return jax.jit(self._iteration_fn(),
+                       donate_argnums=self._train_donate_argnums())
 
     def _build_multi_step(self):
         """K-step fused training program: ONE jitted `lax.scan` runs K
@@ -963,7 +984,8 @@ class Solver:
                     feeds_super)
                 return params, net_state, opt_state, losses, rates, gstate
 
-            return jax.jit(multi_g, donate_argnums=(0, 1, 2))
+            return jax.jit(multi_g,
+                           donate_argnums=self._train_donate_argnums())
 
         def multi(params, net_state, opt_state, feeds_super, it0, base_rng):
             def scan_body(carry, feeds_stack):
@@ -976,7 +998,7 @@ class Solver:
                 scan_body, (params, net_state, opt_state, it0), feeds_super)
             return params, net_state, opt_state, losses, rates
 
-        return jax.jit(multi, donate_argnums=(0, 1, 2))
+        return jax.jit(multi, donate_argnums=self._train_donate_argnums())
 
     # ------------------------------------------------------------------
     def _chunk_at(self, it: int, n: int, testing: bool = True) -> int:

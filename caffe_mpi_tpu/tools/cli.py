@@ -10,7 +10,7 @@ Usage (gflags-compatible single-dash long flags accepted):
     python -m caffe_mpi_tpu.tools.cli test -model net.prototxt -weights w.caffemodel -iterations 50
     python -m caffe_mpi_tpu.tools.cli time -model net.prototxt -iterations 50
     python -m caffe_mpi_tpu.tools.cli device_query
-    python -m caffe_mpi_tpu.tools.cli serve -model deploy.prototxt -weights w.caffemodel [-port 5000] [-smoke N] [-serve_queue_limit Q] [-serve_deadline_ms D] [-serve_stall_s S] [-serve_decoded_cache_mb M] [-watch SNAPSHOT_PREFIX]
+    python -m caffe_mpi_tpu.tools.cli serve -model deploy.prototxt -weights w.caffemodel [-port 5000] [-smoke N] [-serve_queue_limit Q] [-serve_deadline_ms D] [-serve_stall_s S] [-serve_decoded_cache_mb M] [-serve_program_bank DIR [-require_bank_warm]] [-watch SNAPSHOT_PREFIX]
 """
 
 from __future__ import annotations
@@ -320,6 +320,23 @@ def _parser() -> argparse.ArgumentParser:
                    "hot images skip JPEG/PNG decode entirely (overrides "
                    "ServingParameter serve_decoded_cache_mb; -1 = schema "
                    "default 0 = cache off)")
+    p.add_argument("-serve_program_bank", "--serve-program-bank",
+                   dest="serve_program_bank", default="",
+                   help="serve: persistent AOT program bank directory "
+                   "(ISSUE 17) — each warmed bucket executable is "
+                   "serialized there under a verified-atomic crc32c "
+                   "manifest, and a bank-warm restart deserializes the "
+                   "whole ladder with ZERO compiles (compile_count == "
+                   "bank_misses; torn/stale entries recompile and "
+                   "repopulate). Overrides ServingParameter "
+                   "serve_program_bank; '' = schema default = bank off")
+    p.add_argument("-require_bank_warm", "--require-bank-warm",
+                   dest="require_bank_warm", action="store_true",
+                   help="serve -smoke: fail unless the whole ladder "
+                   "loaded from the program bank with zero compiles "
+                   "(tpu_validation's serve-bank stage — a silent "
+                   "recompile on hardware would invalidate the "
+                   "zero-compile cold-start claim)")
     p.add_argument("-watch", "--watch", dest="serve_watch", default="",
                    help="serve: snapshot prefix to tail for verified "
                    "hot-swaps — each newly crc32c-verified snapshot is "
@@ -1008,6 +1025,8 @@ def cmd_serve(args) -> int:
         sp.serve_stall_s = args.serve_stall_s
     if args.serve_decoded_cache_mb >= 0:
         sp.serve_decoded_cache_mb = args.serve_decoded_cache_mb
+    if args.serve_program_bank:
+        sp.serve_program_bank = args.serve_program_bank
     # serving run journal (<model>.serve.run.json): breaker trips, hot
     # swaps + rejections, shutdown — next to the deploy prototxt
     engine = ServingEngine(sp, journal=os.path.splitext(args.model)[0])
@@ -1125,12 +1144,31 @@ def _serve_smoke(args, engine, srv) -> int:
                 sent_http, ing["decode_plane"]["native_records"],
                 ing["fused_rows"])
             return 1
+        if args.require_bank_warm and (
+                engine.bank is None or engine.compile_count != 0
+                or engine.bank_hits != engine.warmed_buckets):
+            log.error(
+                "serve smoke: program bank was NOT warm (%d compiles, "
+                "%d bank hits vs %d warmed buckets, bank %s) — the "
+                "zero-compile cold-start claim did not hold",
+                engine.compile_count, engine.bank_hits,
+                engine.warmed_buckets,
+                engine.bank.path if engine.bank else "OFF")
+            return 1
+        # zero-recompile invariant, extended for the program bank
+        # (ISSUE 17): every warmed bucket either compiled (a counted
+        # bank miss) or deserialized (a hit) — bank off, hits are 0 and
+        # this is the classic compile_count == warmed_buckets
         if stats["post_warmup_compiles"] != 0 or \
-                engine.compile_count != engine.warmed_buckets:
+                engine.compile_count != engine.bank_misses or \
+                engine.compile_count + engine.bank_hits \
+                != engine.warmed_buckets:
             log.error("serve smoke: steady-state serving COMPILED "
-                      "(%d post-warmup; total %d vs %d warmed buckets)",
+                      "(%d post-warmup; total %d vs %d warmed buckets, "
+                      "bank hits %d misses %d)",
                       stats["post_warmup_compiles"], engine.compile_count,
-                      engine.warmed_buckets)
+                      engine.warmed_buckets, engine.bank_hits,
+                      engine.bank_misses)
             return 1
         return 0
     finally:

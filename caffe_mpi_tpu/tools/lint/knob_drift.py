@@ -63,6 +63,7 @@ KNOBS = (
     "serve_deadline_ms",  # ISSUE 12: per-request dispatch deadline
     "serve_stall_s",    # ISSUE 12: serving dispatch stall breaker
     "serve_decoded_cache_mb",  # ISSUE 14: hot-content request cache
+    "serve_program_bank",  # ISSUE 17: persistent AOT program bank
 )
 
 CONFIG_FILE = os.path.join("caffe_mpi_tpu", "proto", "config.py")

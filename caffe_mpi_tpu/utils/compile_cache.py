@@ -16,6 +16,23 @@ DEFAULT_DIR = os.path.join(os.path.expanduser("~"), ".cache",
                            "caffe_mpi_tpu_xla")
 
 
+def runtime_tag() -> str:
+    """Version tag binding a serialized XLA executable to the runtime
+    that produced it — jax + jaxlib versions plus the backend platform
+    and device kind. The program bank (serving/program_bank.py) folds
+    this into every entry fingerprint, so a jaxlib upgrade or a
+    different accelerator silently misses the bank and recompiles
+    instead of deserializing an incompatible program. Touches the
+    backend (jax.devices()), so only call when device work is imminent
+    — the netshape admission planner stays jax-free."""
+    import jax
+    import jaxlib
+    dev = jax.devices()[0]
+    jaxlib_ver = getattr(jaxlib, "__version__", "?")
+    return (f"jax-{jax.__version__}/jaxlib-{jaxlib_ver}"
+            f"/{dev.platform}/{dev.device_kind}")
+
+
 def enable_compile_cache(default_dir: str = DEFAULT_DIR) -> str | None:
     """Returns the cache dir in use, or None when disabled/unsupported."""
     cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR", default_dir)

@@ -152,6 +152,8 @@ FAULT_SITES = {
                     "post-manifest (verify must reject the swap)",
     "swap_canary_bad": "poison a hot-swap candidate's loaded weights "
                        "with NaN (canary gate must roll back)",
+    "bank_corrupt": "flip a byte of a program-bank entry post-manifest "
+                    "(verify must reject it into a counted bank miss)",
 }
 
 class FaultPlane:
@@ -447,6 +449,57 @@ def crc32c_file(path: str, chunk: int = 1 << 22) -> int:
             if not buf:
                 return crc
             crc = _extend(crc, buf)
+
+
+# ---------------------------------------------------------------------------
+# Single-artifact manifests (program-bank entries, ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def write_file_manifest(path: str, **meta) -> str:
+    """Publish the crc32c commit record for ONE standalone artifact —
+    the snapshot-manifest scheme (write_snapshot_manifest) specialised
+    to a single file with no iteration counter. Written LAST, after the
+    artifact itself landed via atomic_output, so "manifest exists and
+    verifies" is the artifact's commit point; extra keyword fields
+    (e.g. a program-bank fingerprint) are stored alongside for
+    observability."""
+    mpath = path + _MANIFEST_SUFFIX
+    doc = {"schema": _MANIFEST_SCHEMA, "time": time.time(),
+           "files": {"artifact": {
+               "file": os.path.basename(path),
+               "size": os.path.getsize(path),
+               "crc32c": f"{crc32c_file(path):08x}",
+           }}}
+    doc.update(meta)
+    with atomic_output(mpath) as tmp:
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+    return mpath
+
+
+def verify_file_manifest(path: str) -> dict | None:
+    """Re-check a single-artifact manifest (write_file_manifest) against
+    the file's current size and crc32c. Returns the manifest dict on
+    success, None on ANY failure — missing/unreadable/torn manifest,
+    missing artifact, size or crc mismatch — so callers treat None as
+    'regenerate the artifact', never as an error to raise."""
+    mpath = path + _MANIFEST_SUFFIX
+    try:
+        with open(mpath) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    ent = (doc.get("files") or {}).get("artifact")
+    if not isinstance(ent, dict) or ent.get("file") != os.path.basename(path):
+        return None
+    try:
+        if os.path.getsize(path) != ent["size"]:
+            return None
+        if f"{crc32c_file(path):08x}" != ent["crc32c"]:
+            return None
+    except (OSError, TypeError):
+        return None
+    return doc
 
 
 # ---------------------------------------------------------------------------

@@ -17,9 +17,13 @@ rest:
      tunnel: a watcher swaps a crc32c-verified snapshot into the live
      engine (canary forward on-chip, zero recompiles) and rejects a
      corrupted one (tools/serve_watch_smoke.py)
-  8. AlexNet trained from a real LMDB through the full host pipeline
+  8. `serve-bank` (ISSUE 17) — persistent program bank: one smoke
+     populates the bank with the TPU executables, a second restarts
+     with `-require_bank_warm` and must warm the whole ladder with
+     ZERO compiles (compile_count == bank_misses == 0)
+  9. AlexNet trained from a real LMDB through the full host pipeline
      (tools/e2e_lmdb_train.py) -> e2e img/s vs the synthetic-feed bench
-  9. `train-multihost` (ISSUE 11) — 2-process elastic cluster,
+ 10. `train-multihost` (ISSUE 11) — 2-process elastic cluster,
      host_loss-injected worker kill -> journaled exit-87 -> coordinated
      supervised recovery, final weights bit-identical to an
      uninterrupted baseline (tools/multihost_smoke.py)
@@ -225,6 +229,29 @@ for causal in (False, True):
             # serving weights bitwise untouched
             run("serve-watch",
                 [py, "tools/serve_watch_smoke.py"], 600, log)
+            # persistent program bank on real hardware (ISSUE 17,
+            # docs/serving.md "Program bank"): first smoke populates the
+            # bank (every bucket compiled over the tunnel, then
+            # serialized + crc32c-manifested); the second is the restart
+            # that matters — -require_bank_warm makes it exit nonzero
+            # unless the WHOLE ladder deserialized from the bank with
+            # ZERO compiles (compile_count == bank_misses == 0). TPU
+            # executables key on the runtime fingerprint, so a jaxlib or
+            # libtpu bump between rounds falls back to a counted miss.
+            bank = "/tmp/caffe_tpu_val/program_bank"
+            shutil.rmtree(bank, ignore_errors=True)
+            run("serve-bank-populate",
+                [py, "-m", "caffe_mpi_tpu.tools.cli", "serve",
+                 "-model", "models/cifar10_quick/deploy.prototxt",
+                 "-smoke", "16", "-serve_window_ms", "10",
+                 "-serve_program_bank", bank],
+                600, log)
+            run("serve-bank-warm",
+                [py, "-m", "caffe_mpi_tpu.tools.cli", "serve",
+                 "-model", "models/cifar10_quick/deploy.prototxt",
+                 "-smoke", "16", "-serve_window_ms", "10",
+                 "-serve_program_bank", bank, "-require_bank_warm"],
+                600, log)
             # flagship fed from a REAL LMDB through the host pipeline —
             # the e2e img/s vs the synthetic-feed bench quantifies the
             # pipeline cost on hardware (VERDICT r4 weak #3). The LMDB

@@ -1193,6 +1193,32 @@ class ServingParameter(Message):
     # counted miss that recompiles and repopulates, never a crash.
     # "" (default) = bank off, today's behavior.
     serve_program_bank: str = ""
+    # serving fleet size (ISSUE 18, docs/serving.md "Fleet"): N >= 1
+    # runs N ServingEngine replica PROCESSES — each bank-warmed via
+    # serve_program_bank, so a supervised respawn is zero-compile —
+    # behind a least-loaded router that retries typed 429/503 sheds on
+    # a healthy sibling, aggregates /stats + /healthz fleet-wide, and
+    # treats a dead replica like a dead training host: heartbeat-
+    # detected, drained from rotation, respawned, re-admitted only
+    # after its readyz gate. 0 (default) = classic single-process
+    # serving, today's behavior.
+    serve_replicas: int = 0
+    # per-request sibling-retry budget for the fleet router (ISSUE 18):
+    # how many OTHER replicas a typed-retryable failure (429 shed,
+    # 503 unhealthy/closed, a dead replica's connection error) may be
+    # retried on before the failure goes typed to the client. A 504
+    # deadline or 400 bad-request is NEVER retried — the deadline is
+    # already spent / the bytes are the client's fault on every
+    # sibling. Default 1: one sibling absorbs a shed.
+    serve_retry_budget: int = 1
+    # replica heartbeat deadline in seconds (ISSUE 18): each replica
+    # publishes beats to the fleet directory; one silent this long is
+    # a DEAD REPLICA — drained from rotation (in-flight requests
+    # resolve typed via the retry path), journaled `replica_dead`,
+    # respawned, and re-admitted after /readyz. The host_deadline
+    # machinery (resilience.HostHeartbeat over DirBeatTransport)
+    # applied to the serving plane. Default 5 s.
+    replica_deadline: float = 5.0
 
 
 SOLVER_TYPE_NAMES = {

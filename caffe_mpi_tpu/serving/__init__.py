@@ -9,6 +9,8 @@ from .engine import (BucketedForward, CompileCounter, InferenceModel,
                      ServingEngine, bucket_for, plan_ladder)
 from .errors import (DeadlineError, EngineClosedError, EngineUnhealthyError,
                      ServingError, ShedError, SwapError)
+from .fleet import (FleetRouter, FleetSupervisor, HttpReplicaClient,
+                    ReplicaHandle, make_router_server)
 from .program_bank import BankStats, ProgramBank
 from .watch import SnapshotWatcher
 
@@ -17,4 +19,6 @@ __all__ = [
     "bucket_for", "plan_ladder", "BankStats", "ProgramBank",
     "ServingError", "ShedError", "DeadlineError", "EngineClosedError",
     "EngineUnhealthyError", "SwapError", "SnapshotWatcher",
+    "FleetRouter", "FleetSupervisor", "HttpReplicaClient",
+    "ReplicaHandle", "make_router_server",
 ]

@@ -94,6 +94,7 @@ class _Handler(BaseHTTPRequestHandler):
     model_name = None
     labels = None
     image_root = None
+    admin = False
 
     def _json(self, code: int, obj) -> None:
         body = json.dumps(obj).encode()
@@ -182,7 +183,47 @@ class _Handler(BaseHTTPRequestHandler):
         self._json(404, {"error": f"no route {url.path}",
                          "kind": "not_found"})
 
+    def _admin_swap(self):
+        """POST /swap (fleet replicas only, ISSUE 18): live-reload this
+        replica's weights from a staged file — the per-replica leg of
+        the router's rolling canary swap. Typed like every other engine
+        failure: a rejected candidate answers with `kind=swap` and the
+        previous weights keep serving; it is the ROUTER's job to roll
+        the rest of the fleet back."""
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            doc = json.loads(self.rfile.read(length))
+            weights = doc["weights"]
+        except (ValueError, KeyError, TypeError):
+            return self._json(400, {"error": "POST /swap wants JSON "
+                                             '{"weights": path, '
+                                             '"canary": bool, '
+                                             '"source": str}',
+                                    "kind": "bad_request"})
+        name = doc.get("model", self.model_name)
+        try:
+            self.engine.swap_weights(name, weights,
+                                     canary=bool(doc.get("canary", True)),
+                                     source=doc.get("source", "fleet"))
+        except KeyError:
+            return self._json(404, {"error": f"no model {name!r}",
+                                    "kind": "not_found"})
+        except ServingError as e:
+            # SwapError included: machine-typed so the router can tell
+            # a rejection (roll back the fleet) from a replica death
+            return self._json(e.http_status,
+                              {"error": str(e), "kind": e.kind})
+        self._json(200, {"swapped": True, "model": name,
+                         "swaps": self.engine.swaps})
+
     def do_POST(self):
+        if urlparse(self.path).path == "/swap":
+            if not self.admin:
+                # the admin surface only exists on fleet replicas —
+                # a public front must not accept weight swaps
+                return self._json(404, {"error": "no route /swap",
+                                        "kind": "not_found"})
+            return self._admin_swap()
         if urlparse(self.path).path != "/classify":
             return self._json(404, {"error": "POST /classify",
                                     "kind": "not_found"})
@@ -214,10 +255,12 @@ class _Handler(BaseHTTPRequestHandler):
 
 def make_server(engine, model_name: str = "default", labels=None,
                 image_root: str | None = None, port: int = 5000,
-                host: str = "127.0.0.1") -> ThreadingHTTPServer:
+                host: str = "127.0.0.1",
+                admin: bool = False) -> ThreadingHTTPServer:
     """HTTP front-end over an already-loaded ServingEngine (port=0 picks
     an ephemeral port — tests/smoke). `labels` is a list of class names
-    or a path to a labels file."""
+    or a path to a labels file. `admin=True` (fleet replicas, bound to
+    loopback by their supervisor) additionally mounts POST /swap."""
     if isinstance(labels, str):
         with open(labels) as f:
             labels = [line.strip() for line in f]
@@ -226,5 +269,6 @@ def make_server(engine, model_name: str = "default", labels=None,
         "model_name": model_name,
         "labels": labels,
         "image_root": image_root,
+        "admin": admin,
     })
     return ThreadingHTTPServer((host, port), handler)

@@ -69,6 +69,16 @@ LOCK_ORDER: tuple[tuple[str, str], ...] = (
     # bump() holds BankStats._lock for six attribute increments and
     # never blocks or takes further locks, so the nesting is one-way.
     ("program_bank._WRITE_LOCK", "BankStats._lock"),
+    # the fleet router's rolling swap (ISSUE 18): _swap_lock serializes
+    # a rollout end-to-end (stage -> canary -> propagate -> rollback)
+    # and nests _lock only for rotation snapshots and counter bumps —
+    # every replica HTTP call and file copy runs with _lock RELEASED.
+    # The reverse (holding _lock across a swap) would park every
+    # routed request behind a multi-second rollout and is undeclared.
+    ("FleetRouter._swap_lock", "FleetRouter._lock"),
+    # the rollout journals rejections/rollbacks while still serialized
+    # (write_run_manifest serializes its own same-process writers).
+    ("FleetRouter._swap_lock", "resilience._RUN_MANIFEST_LOCK"),
 )
 
 # Cross-object attribute types the AST cannot infer (constructor

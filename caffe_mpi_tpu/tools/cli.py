@@ -10,7 +10,7 @@ Usage (gflags-compatible single-dash long flags accepted):
     python -m caffe_mpi_tpu.tools.cli test -model net.prototxt -weights w.caffemodel -iterations 50
     python -m caffe_mpi_tpu.tools.cli time -model net.prototxt -iterations 50
     python -m caffe_mpi_tpu.tools.cli device_query
-    python -m caffe_mpi_tpu.tools.cli serve -model deploy.prototxt -weights w.caffemodel [-port 5000] [-smoke N] [-serve_queue_limit Q] [-serve_deadline_ms D] [-serve_stall_s S] [-serve_decoded_cache_mb M] [-serve_program_bank DIR [-require_bank_warm]] [-watch SNAPSHOT_PREFIX]
+    python -m caffe_mpi_tpu.tools.cli serve -model deploy.prototxt -weights w.caffemodel [-port 5000] [-smoke N] [-serve_queue_limit Q] [-serve_deadline_ms D] [-serve_stall_s S] [-serve_decoded_cache_mb M] [-serve_program_bank DIR [-require_bank_warm]] [-watch SNAPSHOT_PREFIX] [-replicas N [-serve_retry_budget R] [-replica_deadline S] [-fleet_dir D]]
 """
 
 from __future__ import annotations
@@ -337,6 +337,43 @@ def _parser() -> argparse.ArgumentParser:
                    "(tpu_validation's serve-bank stage — a silent "
                    "recompile on hardware would invalidate the "
                    "zero-compile cold-start claim)")
+    # serving-fleet flags (ISSUE 18, docs/serving.md 'Fleet')
+    p.add_argument("-replicas", "--replicas", dest="serve_replicas",
+                   type=int, default=-1,
+                   help="serve: run N ServingEngine replica PROCESSES "
+                   "behind a least-loaded typed-retry router with "
+                   "heartbeat replica supervision and rolling -watch "
+                   "swaps (sets ServingParameter serve_replicas; -1 = "
+                   "schema default 0 = classic single-process serving)")
+    p.add_argument("-serve_retry_budget", "--serve-retry-budget",
+                   dest="serve_retry_budget", type=int, default=-1,
+                   help="serve -replicas: how many sibling replicas a "
+                   "typed-retryable failure (429 shed, 503 unhealthy, "
+                   "dead-replica connection error) is retried on before "
+                   "going typed to the client; 504/400 never retry "
+                   "(overrides ServingParameter serve_retry_budget; "
+                   "-1 = schema default 1)")
+    p.add_argument("-replica_deadline", "--replica-deadline",
+                   dest="replica_deadline", type=float, default=-1.0,
+                   help="serve -replicas: replica heartbeat deadline in "
+                   "seconds — one silent this long is drained from "
+                   "rotation, journaled replica_dead, respawned "
+                   "bank-warm, and re-admitted after its readyz gate "
+                   "(overrides ServingParameter replica_deadline; -1 = "
+                   "schema default 5 s)")
+    p.add_argument("-fleet_dir", "--fleet-dir", dest="fleet_dir",
+                   default="",
+                   help="serve -replicas: fleet state directory "
+                   "(heartbeats, staged swap weights, shared program "
+                   "bank, replica logs, run journal); default "
+                   "<model>_fleet. Also marks a spawned replica's own "
+                   "process together with -replica_id (internal)")
+    p.add_argument("-replica_id", "--replica-id", dest="replica_id",
+                   type=int, default=-1,
+                   help="internal: this process IS fleet replica K — "
+                   "publish heartbeats under -fleet_dir and mount the "
+                   "admin POST /swap route (set by FleetSupervisor, "
+                   "not by operators)")
     p.add_argument("-watch", "--watch", dest="serve_watch", default="",
                    help="serve: snapshot prefix to tail for verified "
                    "hot-swaps — each newly crc32c-verified snapshot is "
@@ -1027,9 +1064,32 @@ def cmd_serve(args) -> int:
         sp.serve_decoded_cache_mb = args.serve_decoded_cache_mb
     if args.serve_program_bank:
         sp.serve_program_bank = args.serve_program_bank
+    if args.serve_replicas >= 0:
+        sp.serve_replicas = args.serve_replicas
+    if args.serve_retry_budget >= 0:
+        sp.serve_retry_budget = args.serve_retry_budget
+    if args.replica_deadline >= 0:
+        sp.replica_deadline = args.replica_deadline
+    # fleet mode (ISSUE 18): N replica processes behind the typed-retry
+    # router — this process becomes the router+supervisor and never
+    # builds an engine itself
+    if sp.serve_replicas >= 1 and args.replica_id < 0:
+        return _serve_fleet(args, sp)
+    replica_beat = None
+    if args.replica_id >= 0 and args.fleet_dir:
+        # this process IS fleet replica K: publish heartbeats so the
+        # supervisor can mourn a silent death, and accept admin swaps
+        from ..serving.fleet import ReplicaBeat
+        replica_beat = ReplicaBeat(args.fleet_dir, args.replica_id,
+                                   deadline=sp.replica_deadline)
+        replica_beat.start()
     # serving run journal (<model>.serve.run.json): breaker trips, hot
-    # swaps + rejections, shutdown — next to the deploy prototxt
-    engine = ServingEngine(sp, journal=os.path.splitext(args.model)[0])
+    # swaps + rejections, shutdown — next to the deploy prototxt (fleet
+    # replicas journal per-replica so siblings don't clobber each other)
+    journal = os.path.splitext(args.model)[0]
+    if args.replica_id >= 0:
+        journal += f".r{args.replica_id}"
+    engine = ServingEngine(sp, journal=journal)
     engine.load_model("default", args.model, args.weights or None)
     watcher = None
     if args.serve_watch:
@@ -1038,7 +1098,8 @@ def cmd_serve(args) -> int:
         watcher.start()
     srv = make_server(engine, "default", labels=args.labels or None,
                       image_root=args.image_root or None,
-                      port=args.port if not args.smoke else 0)
+                      port=args.port if not args.smoke else 0,
+                      admin=replica_beat is not None)
     host, port = srv.server_address[:2]
     if not args.smoke:
         log.info("serving on http://%s:%s (model %s, buckets %s, "
@@ -1051,6 +1112,8 @@ def cmd_serve(args) -> int:
         finally:
             if watcher is not None:
                 watcher.stop()
+            if replica_beat is not None:
+                replica_beat.stop()
             srv.shutdown()
             # graceful: stop accepting, flush the window, resolve every
             # in-flight future, then close (docs/serving.md Resilience)
@@ -1061,6 +1124,107 @@ def cmd_serve(args) -> int:
     finally:
         if watcher is not None:
             watcher.stop()
+        if replica_beat is not None:
+            replica_beat.stop()
+
+
+def _serve_fleet(args, sp) -> int:
+    """`caffe serve -replicas N` (ISSUE 18, docs/serving.md "Fleet"):
+    spawn N replica processes (each a full `caffe serve` with its own
+    engine, bank-warmed from the shared program bank), supervise them
+    by heartbeat, and mount the typed-retry router as the public HTTP
+    surface. `-watch` tails snapshots ROUTER-side, so each verified
+    snapshot canaries on one replica before rolling fleet-wide."""
+    from ..serving.fleet import FleetSupervisor, make_router_server
+    fleet_dir = args.fleet_dir or os.path.splitext(args.model)[0] + "_fleet"
+    sup = FleetSupervisor(args.model, args.weights or "",
+                          sp.serve_replicas, fleet_dir, serving_param=sp)
+    log.info("fleet: spawning %d replicas under %s (bank %s, heartbeat "
+             "deadline %.1fs, retry budget %d)", sp.serve_replicas,
+             fleet_dir, sup.bank_dir, sup.deadline,
+             sup.router.retry_budget)
+    sup.start()
+    watcher = None
+    if args.serve_watch:
+        from ..serving.watch import SnapshotWatcher
+        watcher = SnapshotWatcher(sup.router, "default", args.serve_watch)
+        watcher.start()
+    srv = make_router_server(sup.router,
+                             port=args.port if not args.smoke else 0)
+    host, port = srv.server_address[:2]
+    try:
+        if not args.smoke:
+            log.info("fleet router serving on http://%s:%s (%d replicas)",
+                     host, port, sp.serve_replicas)
+            try:
+                srv.serve_forever()
+            except KeyboardInterrupt:
+                pass
+            return 0
+        return _fleet_smoke(args, sup, srv)
+    finally:
+        if watcher is not None:
+            watcher.stop()
+        srv.shutdown()
+        sup.stop()
+
+
+def _fleet_smoke(args, sup, srv) -> int:
+    """`serve -replicas N -smoke M`: M synthetic PNG requests through
+    the real router HTTP surface, then assert every request resolved
+    typed, traffic spread across replicas, and every replica held the
+    bank-extended zero-recompile invariant. The full replica-kill /
+    rolling-swap proof lives in tools/fleet_smoke.py."""
+    import io
+    import json
+    import threading
+    import urllib.error
+    import urllib.request
+    from PIL import Image
+
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    rng = np.random.RandomState(0)
+    url = f"http://127.0.0.1:{srv.server_address[1]}/classify"
+    ok_n = 0
+    for _ in range(args.smoke):
+        buf = io.BytesIO()
+        Image.fromarray(rng.randint(0, 255, (32, 32, 3), np.uint8)
+                        ).save(buf, format="PNG")
+        req = urllib.request.Request(
+            url, data=buf.getvalue(),
+            headers={"Content-Type": "image/png"})
+        try:
+            json.loads(urllib.request.urlopen(req, timeout=60).read())
+            ok_n += 1
+        except urllib.error.HTTPError as e:
+            # typed failures (429/503/504 with a kind) count as resolved
+            doc = json.loads(e.read() or b"{}")
+            if not doc.get("kind"):
+                log.error("fleet smoke: UNTYPED failure %s: %s",
+                          e.code, doc)
+                return 1
+    stats = sup.router.stats()
+    print(json.dumps({"serve_fleet_smoke": stats}))
+    spread = sum(1 for doc in stats["replicas"].values()
+                 if doc.get("requests", 0) > 0)
+    for rid, doc in stats["replicas"].items():
+        if "error" in doc:
+            log.error("fleet smoke: replica %s unreachable", rid)
+            return 1
+        bank = doc.get("bank", {})
+        if doc.get("compile_count") != bank.get("misses") or \
+                doc.get("compile_count", 0) + bank.get("hits", 0) \
+                != doc.get("warmed_buckets"):
+            log.error("fleet smoke: replica %s broke the zero-recompile "
+                      "invariant: %s", rid, doc)
+            return 1
+    if ok_n == 0 or (args.smoke >= 8 and spread < 2
+                     and stats["fleet"]["replicas"] > 1):
+        log.error("fleet smoke: no spread (%d ok, %d replicas served)",
+                  ok_n, spread)
+        return 1
+    return 0
 
 
 def _serve_smoke(args, engine, srv) -> int:

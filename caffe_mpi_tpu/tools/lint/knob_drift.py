@@ -64,6 +64,9 @@ KNOBS = (
     "serve_stall_s",    # ISSUE 12: serving dispatch stall breaker
     "serve_decoded_cache_mb",  # ISSUE 14: hot-content request cache
     "serve_program_bank",  # ISSUE 17: persistent AOT program bank
+    "serve_replicas",   # ISSUE 18: serving fleet size (replica procs)
+    "serve_retry_budget",  # ISSUE 18: router sibling-retry budget
+    "replica_deadline",  # ISSUE 18: replica heartbeat deadline
 )
 
 CONFIG_FILE = os.path.join("caffe_mpi_tpu", "proto", "config.py")

@@ -154,6 +154,12 @@ FAULT_SITES = {
                        "with NaN (canary gate must roll back)",
     "bank_corrupt": "flip a byte of a program-bank entry post-manifest "
                     "(verify must reject it into a counted bank miss)",
+    "replica_dead": "kill a serving replica at a heartbeat boundary "
+                    "(beat seq >= arg) — a fleet replica dying "
+                    "mid-traffic",
+    "fleet_swap_canary_bad": "flip a byte of the fleet's staged swap "
+                             "candidate pre-canary (the rolling swap "
+                             "must reject and roll back)",
 }
 
 class FaultPlane:
@@ -1285,6 +1291,23 @@ class HostHeartbeat:
         if self.hard_exit:
             logging.shutdown()
             os._exit(EXIT_CLUSTER)
+
+    def revive(self, peer: int) -> None:
+        """Resume monitoring after `peer` was mourned and supervised
+        back up (serving fleet, ISSUE 18). Training mourns once and
+        hard-exits for a coordinated restart, so `tick()` latches
+        `lost` and stops monitoring EVERY peer; a fleet supervisor
+        instead respawns the dead replica in place and needs the
+        heartbeat back. Clearing the latch re-arms all peers, and the
+        respawned incarnation gets a fresh first-contact grace window
+        (it beats from seq 0 under a new transport incarnation token —
+        the surrogate-sequence fold reads that as an advance, never as
+        staleness)."""
+        self.lost = None
+        self.lost_event.clear()
+        self._first[peer] = True
+        self._last_seen[peer] = time.monotonic()
+        self._done.discard(peer)
 
     def farewell(self) -> None:
         """Publish the clean-departure marker (call at solver close,

@@ -1,0 +1,383 @@
+"""Fleet router contract (ISSUE 18, docs/serving.md "Fleet").
+
+The router half of serving/fleet.py is pure HTTP plumbing — no engine,
+no jax, no subprocesses — so its contract is held here with fake
+replica clients: least-loaded spread, typed-retry policy (429/503
+retried on a sibling within `serve_retry_budget`, 504/400 NEVER
+retried, connection errors typed `replica_lost`), fleet-wide stats/
+healthz/readyz aggregation, and the rolling canary swap (a rejection
+anywhere leaves the fleet serving the previous weights file — the
+same bytes, hence bitwise). The real multi-process replica-kill proof
+lives in tools/fleet_smoke.py; the heartbeat revive contract the
+supervisor depends on is held at the bottom.
+"""
+
+import os
+import time
+
+import pytest
+
+from caffe_mpi_tpu.serving.errors import SwapError
+from caffe_mpi_tpu.serving.fleet import (FleetRouter, ReplicaHandle,
+                                         RETRYABLE_KINDS)
+from caffe_mpi_tpu.serving.watch import SnapshotWatcher
+from caffe_mpi_tpu.utils import resilience
+from caffe_mpi_tpu.utils.resilience import FAULTS
+
+OK = (200, {"predictions": [{"label": 0, "score": 1.0}]})
+SHED = (429, {"error": "shed", "kind": "shed"})
+UNHEALTHY = (503, {"error": "breaker open", "kind": "unhealthy"})
+DEADLINE = (504, {"error": "deadline", "kind": "deadline"})
+BAD = (400, {"error": "bad bytes", "kind": "bad_request"})
+SWAP_OK = (200, {"swapped": True})
+SWAP_REJECT = (500, {"error": "canary scores are non-finite",
+                     "kind": "swap"})
+
+
+class FakeClient:
+    """Scripted replica: `responses` is consumed one per classify call
+    (the last entry repeats); an Exception entry is raised instead of
+    returned (connection-level death). Swap calls are recorded with
+    their payloads."""
+
+    def __init__(self, responses=(OK,), swap=(SWAP_OK,), ready=True,
+                 stats=None):
+        self._responses = list(responses)
+        self._swap = list(swap)
+        self.ready = ready
+        self.stats_doc = stats if stats is not None else {"requests": 0}
+        self.classify_calls = 0
+        self.swap_calls = []
+
+    def _next(self, script):
+        r = script.pop(0) if len(script) > 1 else script[0]
+        if isinstance(r, Exception):
+            raise r
+        return r
+
+    def classify(self, body, content_type=""):
+        self.classify_calls += 1
+        return self._next(self._responses)
+
+    def get(self, path):
+        if path == "/readyz":
+            return (200, {"ready": True}) if self.ready \
+                else (503, {"ready": False})
+        if path == "/stats":
+            return 200, self.stats_doc
+        return 404, {"kind": "not_found"}
+
+    def swap(self, payload):
+        self.swap_calls.append(dict(payload))
+        return self._next(self._swap)
+
+
+def make_router(clients, **kw):
+    handles = [ReplicaHandle(i, client=c) for i, c in enumerate(clients)]
+    return FleetRouter(handles, **kw)
+
+
+# ---------------------------------------------------------------------------
+# routing + retry policy
+# ---------------------------------------------------------------------------
+
+def test_least_loaded_pick():
+    router = make_router([FakeClient(), FakeClient(), FakeClient()])
+    router.handle(0).in_flight = 2
+    router.handle(1).in_flight = 0
+    router.handle(2).in_flight = 1
+    h = router._pick(set())
+    assert h.rid == 1
+    assert h.in_flight == 1  # the pick claims a slot
+
+
+def test_idle_fleet_still_spreads():
+    a, b = FakeClient(), FakeClient()
+    router = make_router([a, b])
+    for _ in range(4):
+        status, _ = router.classify(b"img")
+        assert status == 200
+    # in_flight ties on every request (synchronous calls release the
+    # slot); the rotating tiebreak must still alternate replicas
+    assert a.classify_calls == 2 and b.classify_calls == 2
+
+
+def test_shed_retried_on_sibling_and_absorbed():
+    # the first request's rotating tiebreak picks rid 1 — make IT shed
+    absorber, shedder = FakeClient(), FakeClient(responses=[SHED])
+    router = make_router([absorber, shedder], retry_budget=1)
+    status, doc = router.classify(b"img")
+    assert status == 200
+    assert shedder.classify_calls == 1 and absorber.classify_calls == 1
+    assert router.retries == 1
+    assert router.sheds_absorbed == 1
+
+
+def test_unhealthy_retried_on_sibling():
+    absorber, sick = FakeClient(), FakeClient(responses=[UNHEALTHY])
+    router = make_router([absorber, sick], retry_budget=1)
+    status, _ = router.classify(b"img")
+    assert status == 200
+    assert router.retries == 1
+
+
+def test_retry_budget_exhausted_goes_typed():
+    clients = [FakeClient(responses=[SHED]) for _ in range(3)]
+    router = make_router(clients, retry_budget=1)
+    status, doc = router.classify(b"img")
+    assert status == 429 and doc["kind"] == "shed"
+    # budget 1 = the original attempt + ONE sibling, not the whole fleet
+    assert sum(c.classify_calls for c in clients) == 2
+    assert router.retries == 1
+
+
+@pytest.mark.parametrize("resp", [DEADLINE, BAD])
+def test_terminal_kinds_never_retried(resp):
+    assert resp[1]["kind"] not in RETRYABLE_KINDS
+    sibling = FakeClient()
+    failing = FakeClient(responses=[resp])
+    router = make_router([sibling, failing], retry_budget=3)
+    status, doc = router.classify(b"img")
+    assert (status, doc["kind"]) == (resp[0], resp[1]["kind"])
+    assert sibling.classify_calls == 0  # the sibling never saw it
+    assert router.retries == 0
+
+
+def test_conn_error_typed_retried_and_drained():
+    survivor = FakeClient()
+    dead = FakeClient(responses=[ConnectionRefusedError("down")])
+    router = make_router([survivor, dead], retry_budget=1)
+    status, _ = router.classify(b"img")
+    assert status == 200
+    assert router.conn_errors == 1
+    # the corpse left rotation without waiting for the heartbeat
+    assert router.health()["in_rotation"] == 1
+    assert not router.handle(1).in_rotation
+
+
+def test_conn_error_with_no_budget_is_replica_lost():
+    dead = FakeClient(responses=[ConnectionRefusedError("down")])
+    router = make_router([dead], retry_budget=0)
+    status, doc = router.classify(b"img")
+    assert status == 503 and doc["kind"] == "replica_lost"
+
+
+def test_empty_rotation_is_typed_unhealthy():
+    router = make_router([FakeClient(), FakeClient()])
+    router.mark_down(0)
+    router.mark_down(1)
+    status, doc = router.classify(b"img")
+    assert status == 503 and doc["kind"] == "unhealthy"
+
+
+# ---------------------------------------------------------------------------
+# fleet-wide aggregation
+# ---------------------------------------------------------------------------
+
+def test_stats_aggregation():
+    a = FakeClient(stats={"requests": 7, "compile_count": 2})
+    b = FakeClient(responses=[ConnectionRefusedError("down")],
+                   stats={"requests": 3})
+    router = make_router([a, b], retry_budget=1)
+    router.classify(b"img")
+    router.classify(b"img")
+    doc = router.stats()
+    fleet = doc["fleet"]
+    assert fleet["replicas"] == 2 and fleet["routed"] == 2
+    assert doc["replicas"]["0"]["requests"] == 7
+    assert doc["replicas"]["1"]["requests"] == 3  # stats still reachable
+
+
+def test_healthz_aggregation():
+    router = make_router([FakeClient(), FakeClient()])
+    assert router.health()["healthy"]
+    router.mark_down(0)
+    assert router.health()["healthy"]  # one survivor suffices
+    router.mark_down(1)
+    h = router.health()
+    assert not h["healthy"] and h["in_rotation"] == 0
+    router.mark_up(0)
+    assert router.health()["healthy"]
+
+
+def test_readyz_aggregation():
+    a, b = FakeClient(), FakeClient(ready=False)
+    router = make_router([a, b])
+    ok, doc = router.ready()
+    assert not ok and doc["replicas"]["1"]["ready"] is False
+    b.ready = True
+    ok, _ = router.ready()
+    assert ok
+    router.mark_down(0)  # out of rotation == not ready fleet-wide
+    ok, doc = router.ready()
+    assert not ok and doc["replicas"]["0"]["in_rotation"] is False
+
+
+# ---------------------------------------------------------------------------
+# rolling canary swap
+# ---------------------------------------------------------------------------
+
+def _weights(tmp_path, name, payload):
+    p = tmp_path / name
+    p.write_bytes(payload)
+    return str(p)
+
+
+def test_rolling_swap_propagates(tmp_path):
+    clients = [FakeClient() for _ in range(3)]
+    router = make_router(clients, stage_dir=str(tmp_path / "stage"))
+    w = _weights(tmp_path, "v2.caffemodel", b"weights-v2-bytes")
+    router.swap_weights("default", w, source="iter_10")
+    assert router.swaps == 1
+    for i, c in enumerate(clients):
+        assert len(c.swap_calls) == 1
+        # the canary flag lands on exactly ONE replica — the canary
+        assert c.swap_calls[0]["canary"] is (i == 0)
+        assert c.swap_calls[0]["source"] == "iter_10"
+    # every replica read ONE staged immutable copy, bitwise the source
+    staged = clients[0].swap_calls[0]["weights"]
+    assert all(c.swap_calls[0]["weights"] == staged for c in clients)
+    with open(staged, "rb") as f:
+        assert f.read() == b"weights-v2-bytes"
+    assert router.current_weights == staged
+
+
+def test_canary_rejection_touches_no_sibling(tmp_path):
+    canary = FakeClient(swap=[SWAP_REJECT])
+    rest = [FakeClient(), FakeClient()]
+    router = make_router([canary] + rest,
+                         stage_dir=str(tmp_path / "stage"))
+    w = _weights(tmp_path, "bad.caffemodel", b"poison")
+    with pytest.raises(SwapError):
+        router.swap_weights("default", w, source="iter_20")
+    assert router.swaps == 0 and router.swap_rejections == 1
+    assert len(canary.swap_calls) == 1
+    assert all(not c.swap_calls for c in rest)  # rollout never started
+
+
+def test_midrollout_rejection_rolls_back_bitwise(tmp_path):
+    prev = _weights(tmp_path, "v1.caffemodel", b"previous-bytes")
+    ok1, ok2 = FakeClient(), FakeClient()
+    rejector = FakeClient(swap=[SWAP_REJECT])
+    router = make_router([ok1, rejector, ok2],
+                         current_weights=prev,
+                         stage_dir=str(tmp_path / "stage"))
+    w = _weights(tmp_path, "v2.caffemodel", b"candidate-bytes")
+    with pytest.raises(SwapError):
+        router.swap_weights("default", w, source="iter_30")
+    # the canary had swapped; the rejection must roll it back to the
+    # PREVIOUS weights file — the same bytes that were serving before
+    assert len(ok1.swap_calls) == 2
+    rollback = ok1.swap_calls[1]
+    assert rollback["weights"] == prev and rollback["canary"] is False
+    with open(rollback["weights"], "rb") as f:
+        assert f.read() == b"previous-bytes"
+    # the replica AFTER the rejector never saw the candidate at all
+    assert not ok2.swap_calls
+    assert router.rollbacks == 1 and router.swaps == 0
+    assert router.current_weights == prev  # a failed rollout never advances
+
+
+def test_fleet_swap_canary_bad_site_rots_the_staged_copy(tmp_path):
+    clients = [FakeClient()]
+    router = make_router(clients, stage_dir=str(tmp_path / "stage"))
+    w = _weights(tmp_path, "v3.caffemodel", b"A" * 64)
+    FAULTS.configure("fleet_swap_canary_bad:1")
+    try:
+        router.swap_weights("default", w)
+    finally:
+        FAULTS.configure("")
+    staged = clients[0].swap_calls[0]["weights"]
+    with open(staged, "rb") as f:
+        rotted = f.read()
+    # the site rots the STAGED copy (what the canary replica loads),
+    # never the operator's source file
+    assert rotted != b"A" * 64
+    with open(w, "rb") as f:
+        assert f.read() == b"A" * 64
+
+
+def test_snapshot_watcher_drives_the_router_unmodified(tmp_path):
+    """-watch under -replicas: the router IS the watcher's engine —
+    same two-method facade, zero watcher changes (the tentpole's
+    rolling-swap wiring)."""
+    clients = [FakeClient(), FakeClient()]
+    router = make_router(clients, stage_dir=str(tmp_path / "stage"))
+    prefix = str(tmp_path / "snap")
+    mpath = _weights(tmp_path, "snap_iter_10.caffemodel", b"model-bytes")
+    spath = _weights(tmp_path, "snap_iter_10.solverstate", b"state")
+    resilience.write_snapshot_manifest(spath, 10,
+                                       {"model": mpath, "state": spath})
+    watcher = SnapshotWatcher(router, "default", prefix, poll_s=0.05)
+    assert watcher.check_once()
+    assert router.swaps == 1
+    assert all(len(c.swap_calls) == 1 for c in clients)
+    assert clients[0].swap_calls[0]["source"] == "iter_10"
+
+
+def test_watcher_rejection_via_router_is_counted(tmp_path):
+    clients = [FakeClient(swap=[SWAP_REJECT]), FakeClient()]
+    router = make_router(clients, stage_dir=str(tmp_path / "stage"))
+    prefix = str(tmp_path / "snap")
+    mpath = _weights(tmp_path, "snap_iter_5.caffemodel", b"bad-model")
+    spath = _weights(tmp_path, "snap_iter_5.solverstate", b"state")
+    resilience.write_snapshot_manifest(spath, 5,
+                                       {"model": mpath, "state": spath})
+    watcher = SnapshotWatcher(router, "default", prefix, poll_s=0.05)
+    assert not watcher.check_once()
+    assert router.swap_rejections == 1 and router.swaps == 0
+    assert not clients[1].swap_calls
+    assert not watcher.check_once()  # rejected iterations stay rejected
+    assert len(clients[0].swap_calls) == 1
+
+
+# ---------------------------------------------------------------------------
+# heartbeat revive (the supervisor's respawn re-arm)
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_revive_rearms_monitoring(tmp_path):
+    hb_dir = str(tmp_path / "hb")
+    replica = resilience.DirBeatTransport(hb_dir)
+    hb = resilience.HostHeartbeat(
+        resilience.DirBeatTransport(hb_dir), host_id=1, n_hosts=2,
+        deadline=0.15, grace=0.15, interval=0.05, hard_exit=False)
+    replica.publish(0, 0)
+    hb.tick()
+    assert hb.lost is None and hb.beats_seen(0) >= 1
+    # silence past deadline+0 (first contact already made) -> mourned
+    time.sleep(0.4)
+    hb.tick()
+    assert hb.lost is not None and hb.lost[0] == 0
+    # ...and tick() latches: nothing is monitored until revive
+    hb.revive(0)
+    assert hb.lost is None and not hb.lost_event.is_set()
+    # a respawned incarnation (new transport instance = new nonce)
+    # restarts at seq 0 — the surrogate fold must read it as ADVANCE
+    respawned = resilience.DirBeatTransport(hb_dir)
+    respawned.publish(0, 0)
+    hb.tick()
+    assert hb.lost is None
+    seen = hb.beats_seen(0)
+    respawned.publish(0, 1)
+    hb.tick()
+    assert hb.beats_seen(0) > seen and hb.lost is None
+
+
+def test_replica_journal_reasons(tmp_path):
+    """replica_dead / fleet_swap journaling through the router's
+    journal prefix — the artifact fleet_smoke asserts on."""
+    router = make_router([FakeClient()],
+                         journal=str(tmp_path / "fleet"),
+                         stage_dir=str(tmp_path / "stage"))
+    with router._lock:
+        router.replica_deaths += 1
+    router._journal("replica_dead", replica=0, elapsed_s=1.0)
+    doc = resilience.read_run_manifest(str(tmp_path / "fleet") + ".serve")
+    assert doc["reason"] == "replica_dead"
+    assert doc["replica_deaths"] == 1 and doc["replica"] == 0
+    w = _weights(tmp_path, "v9.caffemodel", b"w")
+    router.swap_weights("default", w)
+    doc = resilience.read_run_manifest(str(tmp_path / "fleet") + ".serve")
+    assert doc["reason"] == "fleet_swap" and doc["fleet_swaps"] == 1
+    # the cumulative counters survive the overwrite-style journal
+    assert doc["replica_deaths"] == 1

@@ -51,6 +51,21 @@ def imgs(n, seed=0):
     return [r.rand(8, 8, 3).astype(np.float32) for _ in range(n)]
 
 
+def wait_dispatcher_took(eng, timeout=5.0):
+    """Block until the dispatcher has pulled every pending request into
+    a batch. The stall-vs-deadline tests need request A *inside* its
+    injected dispatch stall before request B is submitted; on a loaded
+    host the dispatcher thread can lag both submits and A+B would ride
+    one batch."""
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        with eng._batcher._cv:
+            if not eng._batcher._pending:
+                return
+        time.sleep(0.005)
+    raise TimeoutError("dispatcher never took the pending request")
+
+
 def publish_snapshot(prefix, it, model_file, scale=3.0, weights_from=None):
     """Write a verified flat snapshot set (<prefix>_iter_<it>.caffemodel
     + .solverstate + crc32c manifest) whose ip weights are `scale`x the
@@ -151,6 +166,7 @@ class TestDeadline:
         with ServingEngine(window_ms=0, deadline_ms=100) as eng:
             eng.load_model("m", model, weights)
             fa = eng.submit("m", imgs(1)[0])
+            wait_dispatcher_took(eng)  # A is inside the stall, alone
             fb = eng.submit("m", imgs(1)[0])
             assert fa.result(timeout=10).shape == (5,)
             with pytest.raises(DeadlineError) as ei:
@@ -544,6 +560,7 @@ class TestHttpFront:
             web = _Server(eng)
             try:
                 fa = eng.submit("m", imgs(1)[0])  # occupies dispatcher
+                wait_dispatcher_took(eng)
                 code, doc = web.post_png()
                 assert code == 504 and doc["kind"] == "deadline"
                 fa.result(timeout=10)

@@ -164,14 +164,41 @@ def main() -> int:
     # must run ZERO compiles with bitwise score parity
     stats["cold_start"] = cold_start_phase(paths, shapes, tmp)
 
+    # fleet phase (ISSUE 18): 2 real replica processes behind the
+    # typed-retry router — replica kill under live traffic (p99 holds,
+    # every future typed, bank-warm zero-compile respawn, journaled
+    # replica_dead) plus the rolling canary swap + bitwise rejection
+    stats["fleet"] = fleet_phase()
+
     import jax
     stats["platform"] = jax.devices()[0].platform
     print(json.dumps({"serving": stats}))
     ok = (stats["zero_recompile"]
           and stats["budgeted"]["zero_recompile"]
           and stats["swap"]["ok"] and stats["shed"]["ok"]
-          and stats["ingest"]["ok"] and stats["cold_start"]["ok"])
+          and stats["ingest"]["ok"] and stats["cold_start"]["ok"]
+          and stats["fleet"]["ok"])
     return 0 if ok else 1
+
+
+def fleet_phase() -> dict:
+    """Run tools/fleet_smoke.py in-process (the same import idiom as
+    swap_phase's serve_watch_smoke publish helper) and fold its report
+    into the serving line: shed/retry accounting, p99-under-kill,
+    bank-warm respawn, rolling-swap + rejection bitwise-ness. The
+    smoke's `ok` is rc-enforced here like every other phase."""
+    sys.path.insert(0, os.path.join(_ROOT, "tools"))
+    import fleet_smoke
+    report = fleet_smoke.run_fleet_smoke()
+    return {
+        "ok": bool(report.get("ok")),
+        "baseline_p99_ms": report.get("baseline", {}).get("p99_ms"),
+        "kill": report.get("kill"),
+        "respawn": report.get("respawn"),
+        "swap": report.get("swap"),
+        "reject": report.get("reject"),
+        "elapsed_s": report.get("elapsed_s"),
+    }
 
 
 def swap_phase(model_path: str, shape, tmp: str) -> dict:

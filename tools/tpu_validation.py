@@ -27,6 +27,11 @@ rest:
      host_loss-injected worker kill -> journaled exit-87 -> coordinated
      supervised recovery, final weights bit-identical to an
      uninterrupted baseline (tools/multihost_smoke.py)
+ 11. `serve-fleet` (ISSUE 18) — 2-replica serving fleet behind the
+     typed-retry router: replica_dead-injected kill under live traffic
+     -> typed futures, held p99, journaled death, bank-warm
+     zero-compile respawn, rolling canary swap + bitwise rejection
+     (tools/fleet_smoke.py)
 
 Usage: python tools/tpu_validation.py [--quick]
 Writes a summary to tpu_validation.log (repo root).
@@ -276,6 +281,17 @@ for causal in (False, True):
             # this stage into real cross-host collectives.
             run("train-multihost",
                 [py, "tools/multihost_smoke.py", "--json"], 600, log)
+            # serving fleet (ISSUE 18, docs/serving.md "Fleet"): 2
+            # replica processes behind the typed-retry router; the
+            # fault plane kills one at a heartbeat boundary under live
+            # traffic — every future must resolve typed, the survivor's
+            # p99 must hold, the respawn must start bank-warm with zero
+            # compiles, and a rolling swap + NaN-canary rejection must
+            # leave the fleet bitwise. Replicas are CPU-forced like
+            # train-multihost: the single-claim chip cannot host two
+            # engine processes (CLAUDE.md).
+            run("serve-fleet",
+                [py, "tools/fleet_smoke.py", "--json"], 600, log)
     os.replace(partial, final)
     print("summary written to tpu_validation.log")
     return 0

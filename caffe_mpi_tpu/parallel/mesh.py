@@ -363,6 +363,49 @@ def heartbeat_transport():
     return KVBeatTransport()
 
 
+def cluster_generation() -> dict | None:
+    """The generation record this worker was launched under (ISSUE 19,
+    degraded-mode elasticity — docs/robustness.md): the elastic
+    supervisor (resilience.supervise_elastic) exports the current
+    generation's shape per child via env. None outside a min_hosts
+    run (plain ISSUE 11 clusters and single-host runs), so every
+    consumer degrades to today's behavior."""
+    import os
+    gen = os.environ.get("CAFFE_TPU_CLUSTER_GEN", "")
+    hosts = os.environ.get("CAFFE_TPU_CLUSTER_HOSTS", "")
+    if not gen or not hosts:
+        return None
+    try:
+        return {
+            "generation": int(gen),
+            "hosts": [int(h) for h in hosts.split(",") if h != ""],
+            "world_full": int(
+                os.environ.get("CAFFE_TPU_WORLD_FULL", "0") or 0),
+            "self": int(
+                os.environ.get("CAFFE_TPU_CLUSTER_SELF", "-1") or -1),
+        }
+    except ValueError:
+        return None
+
+
+def publish_generation() -> bool:
+    """Mirror the live generation record onto the coordination
+    service's KV store at `caffe/cluster_gen` (rank 0, right after
+    formation): peers and in-band tooling can read the cluster's
+    current shape over the channel they already trust. The
+    supervisor's shared `<prefix>.cluster/` directory stays the source
+    of truth — the KV store dies with the cluster epoch, which is
+    exactly when the generation protocol must keep running. False
+    when this is not a generation-managed run (or the service is
+    gone); best-effort either way."""
+    gen = cluster_generation()
+    if gen is None:
+        return False
+    import json
+    return cluster_kv_set("caffe/cluster_gen",
+                          json.dumps(gen, sort_keys=True))
+
+
 def to_host_array(a, dtype=None) -> np.ndarray:
     """np.asarray that also works for arrays with REMOTE shards (multi-host
     ZeRO-1 slots / TP weights), used by snapshot weight + history export.

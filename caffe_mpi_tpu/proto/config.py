@@ -1111,6 +1111,22 @@ class SolverParameter(Message):
     # coordinated restart, instead of hanging inside the next
     # collective. 0 (default) = no heartbeat.
     host_deadline: float = 0.0
+    # TPU-native extension (ISSUE 19, degraded-mode elasticity —
+    # docs/robustness.md "Degraded-mode elasticity"): quorum floor for
+    # continuing after a PERMANENT host loss. > 0 (with hosts > 1 and
+    # a supervisor, --max-restarts) lets the surviving supervisors run
+    # the generation protocol: after exit 87 the lowest surviving host
+    # collects supervisor beats for ~host_deadline, publishes
+    # generation g+1 (surviving host set, remapped contiguous ranks,
+    # new world W' >= min_hosts, fresh coordinator epoch) to the shared
+    # <prefix>.cluster/ directory, and every survivor restarts its
+    # worker at `-hosts W' -host_id k'` with `--resume auto` — rank 0
+    # restores the last verified snapshot resharded onto the smaller
+    # mesh and the Feeder re-stripes at W'. A revived host parks in
+    # rejoin-wait; rank 0 re-admits it at the next snapshot boundary
+    # via a grow-back generation. 0 (default) = off: today's
+    # restart-all-at-same-world semantics, bitwise.
+    min_hosts: int = 0
 
 
 # ---------------------------------------------------------------------------

@@ -314,6 +314,11 @@ class Solver:
         # success.
         self._watchdog = None
         self._heartbeat = None  # ISSUE 11: cross-host loss detection
+        # ISSUE 19: degraded-mode grow-back trigger state — primed
+        # lazily at the first snapshot boundary of a generation that
+        # is missing hosts (see _maybe_admit_rejoin); False = nothing
+        # to admit in this generation (full house / no min_hosts)
+        self._rejoin = None
         self._last_snapshot: tuple[int, str] | None = None
         self._snapshot_error: tuple[int, BaseException] | None = None
         # self-healing state (ISSUE 4): the on-device non-finite guard.
@@ -570,6 +575,16 @@ class Solver:
             out["hosts"] = hosts
             out["cross_host_collectives_per_step"] = (
                 out.get("collectives_per_step", 0) if hosts > 1 else 0)
+            # ISSUE 19: a generation-managed run (min_hosts) reports
+            # WHICH hosts this generation spans — bench.py's MULTICHIP
+            # dryrun surfaces the per-generation host set alongside
+            # the collective counts
+            from ..parallel.mesh import cluster_generation
+            gen = cluster_generation()
+            if gen is not None:
+                out["generation"] = gen["generation"]
+                out["generation_hosts"] = gen["hosts"]
+                out["world_full"] = gen["world_full"]
         return out
 
     def step_hlo_text(self, feeds: dict) -> str:
@@ -1398,6 +1413,60 @@ class Solver:
         except OSError:
             log.exception("run-manifest journal failed (continuing)")
 
+    def _maybe_admit_rejoin(self) -> None:
+        """Degraded-mode grow-back trigger (ISSUE 19, gated on the
+        `min_hosts` solver knob — docs/robustness.md "Degraded-mode
+        elasticity"). In a generation that is missing hosts, rank 0
+        watches the missing hosts' SUPERVISOR beat files (the shared
+        `<prefix>.cluster/` directory the elastic supervisor exports
+        via CAFFE_TPU_CLUSTER_DIR) at every snapshot boundary: the
+        first boundary primes the sequences (a frozen beat file left
+        by the dead incarnation must not read as a revival), and a
+        later boundary that observes an ADVANCE raises a journaled
+        ClusterError with reason `cluster_rejoin` — the worker exits
+        87 on the snapshot it just wrote, and the supervisors'
+        membership round re-forms the cluster one generation up, with
+        the rejoiner re-admitted and every rank resuming from this
+        boundary's snapshot. Zero cost when min_hosts is unset."""
+        if not getattr(self.sp, "min_hosts", 0) or self.rank != 0:
+            return
+        if self._rejoin is False:
+            return
+        if self._rejoin is None:
+            cdir = os.environ.get("CAFFE_TPU_CLUSTER_DIR", "")
+            hosts_env = os.environ.get("CAFFE_TPU_CLUSTER_HOSTS", "")
+            world_full = int(
+                os.environ.get("CAFFE_TPU_WORLD_FULL", "0") or 0)
+            missing: list[int] = []
+            if cdir and hosts_env and world_full:
+                present = {int(h) for h in hosts_env.split(",") if h}
+                missing = sorted(set(range(world_full)) - present)
+            if not (cdir and missing):
+                self._rejoin = False
+                return
+            tr = resilience.DirBeatTransport(os.path.join(cdir, "hb"))
+            self._rejoin = (tr, {h: tr.latest_seq(h) for h in missing})
+            return
+        tr, base = self._rejoin
+        back = []
+        for h, primed in base.items():
+            try:
+                if tr.latest_seq(h) > primed:
+                    back.append(h)
+            except OSError:
+                pass
+        if not back:
+            return
+        self._journal_run_state("cluster_rejoin", critical=True,
+                                rejoining_hosts=back,
+                                boundary_iter=int(self.iter))
+        err = resilience.ClusterError(
+            f"host(s) {back} beating again at snapshot boundary "
+            f"iteration {self.iter}; exiting for the grow-back "
+            f"generation")
+        err.journal_reason = "cluster_rejoin"
+        raise err
+
     # ------------------------------------------------------------------
     def step(self, n: int, feed_fn: FeedFn, test_feed_fns=None) -> float:
         """Run n training iterations (reference Solver::Step)."""
@@ -1565,6 +1634,12 @@ class Solver:
                 # interval snapshots don't stall the train loop (the
                 # reference's do: solver.cpp:339-344 writes inline)
                 self.snapshot(block=False)
+                # ISSUE 19: snapshot boundaries are the only points a
+                # degraded cluster may grow back at (the resume target
+                # the re-formed cluster restores is the snapshot just
+                # written). MAIN thread on purpose: the async snapshot
+                # writer swallows raises into _snapshot_error.
+                self._maybe_admit_rejoin()
         if self._guard_on and self._guard_prev is not None:
             # drain the deferred check so a divergence inside THIS call's
             # final chunk surfaces before step() returns

@@ -207,6 +207,18 @@ def _parser() -> argparse.ArgumentParser:
                    "coordinated restart, instead of hanging inside "
                    "the next collective (overrides solver "
                    "host_deadline; 0 = prototxt value, default off)")
+    p.add_argument("-min_hosts", "--min-hosts", dest="min_hosts",
+                   type=int, default=0,
+                   help="train: degraded-mode quorum floor (ISSUE 19, "
+                   "needs -hosts > 1 and -max_restarts). After a "
+                   "PERMANENT host loss the surviving supervisors run "
+                   "the generation protocol: the lowest survivor "
+                   "publishes a remapped generation with world W' >= "
+                   "min_hosts and training continues at W' from the "
+                   "last verified snapshot; a revived host parks and "
+                   "is re-admitted at the next snapshot boundary "
+                   "(overrides solver min_hosts; 0 = prototxt value, "
+                   "default off = today's restart-all semantics)")
     # self-healing flags (ISSUE 4, docs/robustness.md)
     p.add_argument("-train_guard", "--train-guard", dest="train_guard",
                    action="store_true",
@@ -464,6 +476,24 @@ def _build_feeders(net, phase, rank=0, world=1, model_dir="",
     return None
 
 
+def _strip_flags(argv: list[str], flags: tuple[str, ...],
+                 with_value: bool = True) -> list[str]:
+    """Remove `flags` (and their values / `=`-joined spellings) from a
+    child argv — the supervisor rewrites these per attempt/generation."""
+    out, skip = [], False
+    for tok in argv:
+        if skip:
+            skip = False
+            continue
+        if tok in flags:
+            skip = with_value
+            continue
+        if tok.startswith(tuple(f + "=" for f in flags)):
+            continue
+        out.append(tok)
+    return out
+
+
 def _supervised_train(args) -> int:
     """Supervisor half of `train --max-restarts N`: run the actual
     training loop in a contained child process (own process group,
@@ -471,7 +501,13 @@ def _supervised_train(args) -> int:
     newest verified snapshot with exponential backoff when it dies —
     watchdog hard-exits (code 86) included. The crash-loop guard stops
     after N restarts with the per-attempt record in
-    `<snapshot_prefix>.failures.log`."""
+    `<snapshot_prefix>.failures.log`.
+
+    With `min_hosts` set on a multi-host run (ISSUE 19) the supervisor
+    is the ELASTIC one (resilience.supervise_elastic): child failures
+    run the generation protocol over the shared `<prefix>.cluster/`
+    directory, and each generation's child argv is rewritten to the
+    remapped `-hosts W' -host_id k' -coordinator <epoch>`."""
     import os
     from ..proto import SolverParameter
     from ..utils import resilience
@@ -479,33 +515,77 @@ def _supervised_train(args) -> int:
     argv = list(getattr(args, "_argv", None) or sys.argv[1:])
     # strip the supervision flag from the child's argv (the env marker
     # below is the belt-and-braces recursion stop)
-    flags = ("-max_restarts", "--max-restarts", "--max_restarts")
-    child_argv, skip = [], False
-    for tok in argv:
-        if skip:
-            skip = False
-            continue
-        if tok in flags:
-            skip = True
-            continue
-        if tok.startswith(tuple(f + "=" for f in flags)):
-            continue
-        child_argv.append(tok)
+    child_argv = _strip_flags(
+        argv, ("-max_restarts", "--max-restarts", "--max_restarts"))
+    sp = SolverParameter.from_file(args.solver)
+    prefix = args.snapshot_prefix or sp.snapshot_prefix or "snapshot"
+    env = dict(os.environ, CAFFE_SUPERVISED_CHILD="1")
+    anomaly_action = (args.anomaly_action or sp.anomaly_action
+                      or "rewind")
+
+    # degraded-mode elasticity (ISSUE 19): the generation protocol
+    # engages only when the operator set the quorum floor on a real
+    # multi-host launch — anything else is the classic supervisor,
+    # bitwise
+    min_hosts = args.min_hosts or getattr(sp, "min_hosts", 0)
+    world = args.hosts or sp.hosts \
+        or int(os.environ.get("CAFFE_TPU_NUM_HOSTS", "0") or 0)
+    host_id = args.host_id if args.host_id >= 0 \
+        else int(os.environ.get("CAFFE_TPU_HOST_ID", "-1") or -1)
+    coordinator = args.coordinator or sp.coordinator \
+        or os.environ.get("CAFFE_TPU_COORDINATOR", "")
+    if min_hosts > 0 and world > 1 and host_id >= 0:
+        host_deadline = args.host_deadline or sp.host_deadline or 5.0
+        # the address peers reach THIS host at (the publisher of a new
+        # generation hosts the next coordination-service epoch):
+        # CAFFE_TPU_HOST_ADDR when the operator set it, else the
+        # original coordinator's host part (exact for host 0 and for
+        # single-machine smokes; multi-machine operators set the env)
+        coord_host = os.environ.get("CAFFE_TPU_HOST_ADDR", "") or (
+            coordinator.rsplit(":", 1)[0] if ":" in coordinator
+            else "127.0.0.1")
+        cluster_flags = ("-hosts", "--hosts", "-host_id", "--host-id",
+                         "--host_id", "-coordinator", "--coordinator",
+                         "-resume", "--resume")
+        stable_argv = _strip_flags(child_argv, cluster_flags)
+
+        def build_cmd(gen: dict, rank: int, resume: bool) -> list[str]:
+            cmd = [sys.executable, "-m", "caffe_mpi_tpu.tools.cli"] \
+                + stable_argv + ["-hosts", str(gen["world"]),
+                                 "-host_id", str(rank)]
+            if gen["world"] > 1:
+                cmd += ["-coordinator", gen["coordinator"]]
+            if resume:
+                cmd += ["-resume", "auto"]
+            return cmd
+
+        journal = prefix if host_id == 0 else f"{prefix}.r{host_id}"
+        return resilience.supervise_elastic(
+            build_cmd, prefix=prefix, host_id=host_id,
+            world_full=world, min_hosts=min_hosts,
+            host_deadline=host_deadline, coordinator_host=coord_host,
+            coordinator=coordinator, max_restarts=args.max_restarts,
+            failure_log=journal + ".failures.log", env=env,
+            anomaly_action=anomaly_action,
+            anomaly_lr_mult=sp.anomaly_lr_mult)
+
     base_cmd = [sys.executable, "-m", "caffe_mpi_tpu.tools.cli"] + child_argv
     resume_cmd = base_cmd
     if not any(t in ("-resume", "--resume") or
                t.startswith(("-resume=", "--resume="))
                for t in child_argv):
         resume_cmd = base_cmd + ["-resume", "auto"]
-    sp = SolverParameter.from_file(args.solver)
-    prefix = args.snapshot_prefix or sp.snapshot_prefix or "snapshot"
-    env = dict(os.environ, CAFFE_SUPERVISED_CHILD="1")
+    # fast-fail doomed formation (ISSUE 19): point the supervisor at
+    # this host's cluster journal so repeated cluster_init_failed
+    # records stop the restart loop early (single-host journals never
+    # record that reason, so the param is inert there)
+    journal = prefix if host_id <= 0 else f"{prefix}.r{host_id}"
     return resilience.supervise(
         base_cmd, resume_cmd, args.max_restarts,
         failure_log=prefix + ".failures.log", env=env,
-        anomaly_action=(args.anomaly_action or sp.anomaly_action
-                        or "rewind"),
-        anomaly_lr_mult=sp.anomaly_lr_mult)
+        anomaly_action=anomaly_action,
+        anomaly_lr_mult=sp.anomaly_lr_mult,
+        journal_prefix=journal)
 
 
 def _cluster_exit(prefix: str, rank: int, reason: str, error: str) -> int:
@@ -603,6 +683,8 @@ def cmd_train(args) -> int:
         sp.coordinator = args.coordinator
     if args.host_deadline:
         sp.host_deadline = args.host_deadline
+    if args.min_hosts:
+        sp.min_hosts = args.min_hosts
 
     # elastic multi-host bootstrap (ISSUE 11): form the jax.distributed
     # cluster BEFORE any jax device use, so the mesh below spans every
@@ -617,6 +699,12 @@ def cmd_train(args) -> int:
             sp, host_id=args.host_id)
         if world > 1:
             mesh_mod.init_distributed(coordinator, world, host_rank)
+            if host_rank == 0:
+                # degraded-mode elasticity (ISSUE 19): mirror the
+                # generation record the elastic supervisor handed us
+                # onto the KV store for in-band observability; no-op
+                # outside a min_hosts run
+                mesh_mod.publish_generation()
     except resilience.ClusterError as e:
         return _cluster_exit(journal_prefix, max(host_rank, 0),
                              "cluster_init_failed", str(e))
@@ -775,9 +863,15 @@ def cmd_train(args) -> int:
     # <prefix>.quarantine.json (ISSUE 4; appends across supervised
     # restarts). Multi-host runs journal per host (.r<k>, ISSUE 11);
     # rank 0 merges them at snapshot time.
+    # Across degraded-mode generations (ISSUE 19) a host's RANK moves
+    # (remapped contiguous over the survivors) but its identity does
+    # not: key the journal on the stable original host id the elastic
+    # supervisor publishes, so quarantine attribution survives remaps.
+    _stable_host = os.environ.get("CAFFE_TPU_CLUSTER_SELF")
     resilience.QUARANTINE.configure(resilience.quarantine_journal_path(
         sp.snapshot_prefix or "snapshot", rank=cluster_rank,
-        world=world))
+        world=world,
+        host=int(_stable_host) if _stable_host else None))
 
     t0 = time.time()
     start_iter = solver.iter
@@ -818,9 +912,12 @@ def cmd_train(args) -> int:
     except resilience.ClusterError as e:
         # a cluster operation inside training (sharded-snapshot write
         # barrier) failed in a bounded way — journal + 87, supervisor
-        # restarts the whole cluster
+        # restarts the whole cluster. The rejoin trigger (ISSUE 19)
+        # rides the same exit with reason "cluster_rejoin" so the
+        # elastic supervisor publishes the grow-back generation.
         return _cluster_exit(journal_prefix, cluster_rank,
-                             "cluster_lost", str(e))
+                             getattr(e, "journal_reason", "cluster_lost"),
+                             str(e))
     except resilience.NumericAnomalyError as e:
         # the solver already journaled the anomaly to <prefix>.run.json;
         # exit 88 routes the supervisor through anomaly_action

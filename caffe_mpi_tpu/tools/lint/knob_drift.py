@@ -67,6 +67,7 @@ KNOBS = (
     "serve_replicas",   # ISSUE 18: serving fleet size (replica procs)
     "serve_retry_budget",  # ISSUE 18: router sibling-retry budget
     "replica_deadline",  # ISSUE 18: replica heartbeat deadline
+    "min_hosts",        # ISSUE 19: degraded-mode quorum floor
 )
 
 CONFIG_FILE = os.path.join("caffe_mpi_tpu", "proto", "config.py")

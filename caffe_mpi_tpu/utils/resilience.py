@@ -69,7 +69,15 @@ class ClusterError(RuntimeError):
     journals the event to `<prefix>.run.json` and converts this to exit
     code EXIT_CLUSTER (87) so the supervisor restarts the local worker
     instead of the process hanging inside an uninterruptible
-    collective."""
+    collective.
+
+    `journal_reason` is the run-manifest `reason` the CLI writes for
+    the event; raisers override it per instance when the 87 is not a
+    loss — the degraded-mode rejoin trigger (ISSUE 19) sets
+    "cluster_rejoin" so the supervisor's membership round grows the
+    cluster back instead of merely restarting it."""
+
+    journal_reason = "cluster_lost"
 
 
 class NumericAnomalyError(RuntimeError):
@@ -160,6 +168,10 @@ FAULT_SITES = {
     "fleet_swap_canary_bad": "flip a byte of the fleet's staged swap "
                              "candidate pre-canary (the rolling swap "
                              "must reject and roll back)",
+    "host_perma_loss": "go dark at supervisor level for `arg` seconds "
+                       "after the worker dies — the whole host (worker "
+                       "AND supervisor) is gone, so the survivors must "
+                       "degrade instead of waiting for a restart-all",
 }
 
 class FaultPlane:
@@ -927,13 +939,25 @@ QUARANTINE = QuarantineLog()
 
 
 def quarantine_journal_path(prefix: str, rank: int = 0,
-                            world: int = 1) -> str:
+                            world: int = 1,
+                            host: int | None = None) -> str:
     """Journal file for one host's quarantine decisions. Single-host
     keeps the classic `<prefix>.quarantine.json`; in a multi-host run
     (ISSUE 11) every host journals its OWN stripe's quarantines to
     `<prefix>.quarantine.r<k>.json` (concurrent atomic rewrites of one
     shared file from N hosts would drop entries), and rank 0 merges the
-    per-host journals into the classic path at snapshot time."""
+    per-host journals into the classic path at snapshot time.
+
+    `host` (ISSUE 19) is a STABLE host identity for degraded-mode
+    runs: generation remaps reassign ranks, so a rank-keyed journal
+    would merge one host's quarantines into another host's audit trail
+    after a reshape — when the supervisor publishes an original host
+    id (CAFFE_TPU_CLUSTER_SELF), the journal keys on it instead
+    (`<prefix>.quarantine.h<host>.json`), surviving every generation.
+    Rank-keyed runs (min_hosts unset) keep the classic .r<k> path
+    byte-identical."""
+    if host is not None and world > 1:
+        return prefix + f".quarantine.h{int(host)}.json"
     if world <= 1:
         return prefix + ".quarantine.json"
     return prefix + f".quarantine.r{int(rank)}.json"
@@ -941,17 +965,20 @@ def quarantine_journal_path(prefix: str, rank: int = 0,
 
 def merge_quarantine_journals(prefix: str) -> int:
     """Merge every per-host quarantine journal
-    (`<prefix>.quarantine.r*.json`) into the classic
-    `<prefix>.quarantine.json`, deduped by (source, index) and sorted
-    for a stable audit. Called by rank 0 at snapshot time (the same
-    cadence the single-host journal flushes at). Returns the merged
-    record count; 0 with no per-host journals (single-host runs never
-    pay this)."""
+    (`<prefix>.quarantine.r*.json`, plus the stable-host-keyed
+    `.quarantine.h*.json` spelling degraded-mode runs use — ISSUE 19)
+    into the classic `<prefix>.quarantine.json`, deduped by
+    (source, index) and sorted for a stable audit. Called by rank 0 at
+    snapshot time (the same cadence the single-host journal flushes
+    at). Returns the merged record count; 0 with no per-host journals
+    (single-host runs never pay this)."""
     import glob as _glob
     d = os.path.dirname(prefix) or "."
-    stem = os.path.basename(prefix) + ".quarantine.r"
-    parts = sorted(p for p in _glob.glob(
-        os.path.join(glob_escape(d), glob_escape(stem) + "*.json")))
+    base = os.path.basename(prefix) + ".quarantine."
+    parts = sorted(
+        p for stem in (base + "r", base + "h")
+        for p in _glob.glob(
+            os.path.join(glob_escape(d), glob_escape(stem) + "*.json")))
     if not parts:
         return 0
     merged: dict[tuple, dict] = {}
@@ -1354,7 +1381,8 @@ def supervise(first_cmd: list[str], resume_cmd: list[str],
               deadline: float | None = None,
               backoff_base: float = 1.0, backoff_cap: float = 60.0,
               anomaly_action: str = "rewind",
-              anomaly_lr_mult: float = 0.1) -> int:
+              anomaly_lr_mult: float = 0.1,
+              journal_prefix: str | None = None) -> int:
     """Run a training child to completion, restarting on failure.
 
     Attempt 0 runs `first_cmd`; every restart runs `resume_cmd` (which
@@ -1372,11 +1400,23 @@ def supervise(first_cmd: list[str], resume_cmd: list[str],
     failure; `rewind_lr` additionally appends `-lr_scale` with
     anomaly_lr_mult compounded per numeric restart, so the replay does
     not step straight back into the divergence; `abort` treats the
-    divergence as fatal and returns 88 without restarting."""
+    divergence as fatal and returns 88 without restarting.
+
+    Fast-fail doomed formation (ISSUE 19): `journal_prefix` names this
+    host's run-manifest journal; when EVERY attempt from the start has
+    ended in a fresh `cluster_init_failed` journal, the cluster never
+    formed once — the coordinator/peer is unreachable, and burning the
+    remaining restarts × CAFFE_TPU_INIT_TIMEOUT would only delay the
+    same verdict. Two consecutive such failures give up with one clear
+    message naming the unreachable endpoint. A run whose FIRST
+    formation succeeded (the journal shows any other reason, or none
+    fresh) never fast-fails: a mid-run host loss is exactly what the
+    coordinated restart exists for."""
     from .subproc import run_contained
     os.makedirs(os.path.dirname(failure_log) or ".", exist_ok=True)
     rc = 1
     numeric_restarts = 0
+    never_formed = True
     for attempt in range(max_restarts + 1):
         cmd = first_cmd if attempt == 0 else list(resume_cmd)
         if attempt > 0 and numeric_restarts and anomaly_action == "rewind_lr":
@@ -1415,6 +1455,27 @@ def supervise(first_cmd: list[str], resume_cmd: list[str],
                           "(log: %s)", failure_log)
                 return EXIT_NUMERIC
             numeric_restarts += 1
+        # fast-fail doomed formation (ISSUE 19): only a FRESH
+        # cluster_init_failed journal (written during this attempt)
+        # counts — a stale one from a previous run must not condemn a
+        # cluster that is actually forming
+        init_fail = None
+        if journal_prefix and rc == EXIT_FAULT:
+            man = read_run_manifest(journal_prefix)
+            if (man and man.get("reason") == "cluster_init_failed"
+                    and float(man.get("time", 0) or 0) >= t0):  # lint: ok(host-sync) — journal JSON field, host data
+                init_fail = man.get("error", "")
+        if init_fail is None:
+            never_formed = False
+        elif never_formed and attempt >= 1:
+            log.error(
+                "supervisor: cluster formation failed on every attempt "
+                "(%d of them) — %s; the peer is unreachable, so the "
+                "remaining %d restart(s) would only replay the same "
+                "init timeout. Giving up (log: %s)", attempt + 1,
+                init_fail or "distributed init failed",
+                max_restarts - attempt, failure_log)
+            break
         if attempt >= max_restarts:
             log.error("supervisor: crash-loop guard: %d failure(s); "
                       "giving up (log: %s)", attempt + 1, failure_log)
@@ -1426,3 +1487,416 @@ def supervise(first_cmd: list[str], resume_cmd: list[str],
                     "verified snapshot in %.1fs", reason, verb, delay)
         time.sleep(delay)
     return 1 if rc is None else rc
+
+
+# ---------------------------------------------------------------------------
+# Degraded-mode elasticity (ISSUE 19) — the generation protocol
+# ---------------------------------------------------------------------------
+# A PERMANENTLY dead host defeats PR 10's restart-all recovery: every
+# survivor re-blocks in init_distributed at the old world size until
+# --max-restarts exhausts. The generation protocol reshapes the cluster
+# around the survivors instead. It lives at SUPERVISOR level on shared
+# storage (the same assumption `--resume auto` already makes for
+# snapshots): the coordination-service KV store dies with rank 0's
+# worker, so the durable channel is a `<prefix>.cluster/` directory —
+# DirBeatTransport supervisor liveness beats (keyed on ORIGINAL host
+# ids, which survive every rank remap) plus an atomically-published
+# generation record. Workers mirror the live record onto the KV store
+# at `caffe/cluster_gen` (mesh.publish_generation) for in-band
+# observability; the directory stays the source of truth.
+
+_GEN_FILE = "cluster_gen.json"
+_GEN_DONE = "done"
+
+
+def cluster_dir(prefix: str) -> str:
+    """The generation-protocol state directory for a run: beside the
+    snapshots (shared storage), one per snapshot prefix."""
+    return prefix + ".cluster"
+
+
+def generation_path(cdir: str) -> str:
+    return os.path.join(cdir, _GEN_FILE)
+
+
+def initial_generation(world: int, coordinator: str) -> dict:
+    """Generation 1 — the operator's original launch config. Implicit:
+    it is what every supervisor assumes when no generation record
+    exists, so a min_hosts run with no failures never writes one."""
+    return {"generation": 1, "hosts": list(range(int(world))),
+            "world": int(world), "world_full": int(world),
+            "coordinator": coordinator, "reason": "cluster_formed"}
+
+
+def read_generation(cdir: str) -> dict | None:
+    """The current generation record, or None (= implicit generation
+    1). Torn/invalid records read as None — the publisher's
+    atomic_output makes that window a crash artifact, and falling back
+    to the previous implicit state is always safe (the next membership
+    round republishes)."""
+    try:
+        with open(generation_path(cdir)) as f:
+            doc = json.load(f)
+        if int(doc.get("generation", 0)) >= 1 and doc.get("hosts"):
+            doc["hosts"] = [int(h) for h in doc["hosts"]]
+            return doc
+    except (OSError, ValueError, TypeError):
+        pass
+    return None
+
+
+def write_generation(cdir: str, gen: dict) -> str:
+    """Atomically publish a generation record: the per-generation
+    history file `gen_<g>.json` first (the durable audit trail the
+    degrade smoke asserts on), then the live `cluster_gen.json` as the
+    commit record every parked/restarting supervisor polls."""
+    os.makedirs(cdir, exist_ok=True)
+    g = int(gen["generation"])
+    doc = dict(gen, time=time.time())
+    for path in (os.path.join(cdir, f"gen_{g}.json"),
+                 generation_path(cdir)):
+        with atomic_output(path) as tmp:
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+    try:
+        # a new generation means the run is live again: a done marker
+        # left by an earlier completed run under this prefix must not
+        # release the next run's parked rejoiners
+        os.unlink(os.path.join(cdir, _GEN_DONE))
+    except OSError:
+        pass
+    return generation_path(cdir)
+
+
+def observe_live_hosts(cdir: str, world_full: int, self_host: int,
+                       window: float, *, min_beats: int = 2) -> list[int]:
+    """One membership round: watch the supervisor beat files for
+    `window` seconds and return the sorted original host ids seen
+    ALIVE. Prime-then-count: a fresh transport reads each host's
+    current beat first, then only ADVANCES count — a frozen file left
+    by a dead incarnation never reads as liveness, while a revived
+    host's new incarnation token folds into a surrogate advance
+    (DirBeatTransport). `min_beats` >= 2 rejects a single straggler
+    flush from a host that died mid-publish. The observer itself is
+    always live."""
+    tr = DirBeatTransport(os.path.join(cdir, "hb"))
+    hosts = range(int(world_full))
+    base = {h: tr.latest_seq(h) for h in hosts}
+    advances = {h: 0 for h in hosts}
+    t_end = time.monotonic() + max(window, 0.2)
+    while time.monotonic() < t_end:
+        time.sleep(min(0.1, window / 4))
+        for h in hosts:
+            seq = tr.latest_seq(h)
+            if seq > base[h]:
+                advances[h] += seq - base[h]
+                base[h] = seq
+    live = {h for h in hosts if advances[h] >= min_beats}
+    live.add(int(self_host))
+    return sorted(live)
+
+
+class SupervisorBeat:
+    """Daemon thread publishing this SUPERVISOR's liveness beats
+    (original host id key) to the cluster directory. Distinct from the
+    worker's in-band heartbeat (HostHeartbeat): the worker's dies with
+    the worker, which is precisely when membership must still be
+    observable — a host whose supervisor beats is a rejoin candidate
+    even while its worker is down. pause()/resume() exist for the
+    `host_perma_loss` fault site (the whole host going dark)."""
+
+    def __init__(self, cdir: str, host_id: int, interval: float):
+        self.transport = DirBeatTransport(os.path.join(cdir, "hb"))
+        self.host = int(host_id)
+        self.interval = max(float(interval), 0.05)
+        self._stop = threading.Event()
+        self._paused = threading.Event()
+        self._seq = 0
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"sup-beat-{self.host}")
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if not self._paused.is_set():
+                try:
+                    self.transport.publish(self.host, self._seq)
+                    self._seq += 1
+                except OSError as e:
+                    log.warning("supervisor beat publish failed: %s", e)
+            self._stop.wait(self.interval)
+
+    def pause(self) -> None:
+        self._paused.set()
+
+    def resume(self) -> None:
+        self._paused.clear()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+
+def _wait_generation_advance(cdir: str, beyond: int,
+                             timeout: float) -> dict | None:
+    """Poll for a generation record newer than `beyond` (the
+    non-publisher survivors waiting out the lowest-rank's membership
+    round)."""
+    t_end = time.monotonic() + timeout
+    while time.monotonic() < t_end:
+        gen = read_generation(cdir)
+        if gen and gen["generation"] > beyond:
+            return gen
+        time.sleep(0.2)
+    return None
+
+
+def _rejoin_wait(cdir: str, host_id: int, beyond: int,
+                 park_deadline: float) -> dict | str | None:
+    """Park a host excluded from the current generation: keep
+    publishing supervisor beats (the SupervisorBeat thread is already
+    running) so rank 0's snapshot-boundary rejoin check can see this
+    host alive, and poll until a generation re-admits it, the run
+    finishes (`done` marker), or the park deadline lapses."""
+    log.info("rejoin-wait: generation %d excludes host %d; parking, "
+             "publishing beats until rank 0 re-admits this host at a "
+             "snapshot boundary", beyond, host_id)
+    t_end = time.monotonic() + park_deadline
+    while time.monotonic() < t_end:
+        if os.path.exists(os.path.join(cdir, _GEN_DONE)):
+            return "done"
+        gen = read_generation(cdir)
+        if gen and gen["generation"] > beyond \
+                and int(host_id) in gen["hosts"]:
+            return gen
+        time.sleep(0.25)
+    return None
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def supervise_elastic(build_cmd, *, prefix: str, host_id: int,
+                      world_full: int, min_hosts: int,
+                      host_deadline: float, coordinator_host: str,
+                      coordinator: str, max_restarts: int,
+                      failure_log: str, env: dict | None = None,
+                      cwd: str | None = None,
+                      deadline: float | None = None,
+                      backoff_base: float = 1.0,
+                      backoff_cap: float = 60.0,
+                      anomaly_action: str = "rewind",
+                      anomaly_lr_mult: float = 0.1,
+                      park_deadline: float = 900.0) -> int:
+    """Degraded-mode supervisor (ISSUE 19): `supervise()` plus the
+    generation protocol. `build_cmd(gen, rank, resume)` returns the
+    worker argv for one generation — remapped `-hosts W' -host_id k'
+    -coordinator <epoch>` with `--resume auto` on restarts.
+
+    Per child failure, in order:
+    1. `host_perma_loss` fault site — this supervisor goes dark for
+       `arg` seconds (beats paused), simulating the whole host dead,
+       then revives into step 2.
+    2. A NEWER generation exists: a peer already reshaped the cluster.
+       Including this host -> switch to it with a FRESH restart budget
+       (a generation switch is recovery, not a crash loop); excluding
+       it -> rejoin-wait, parked until rank 0 re-admits it at a
+       snapshot boundary (or the run finishes).
+    3. Exit 87 (cluster event): run a membership round over the
+       supervisor beats for ~`host_deadline`. A changed host set with
+       >= min_hosts survivors is published as generation g+1 by the
+       LOWEST surviving host (who is the new rank 0, so it allocates
+       the new coordinator epoch on its own address); the others wait
+       for that record. Journal events `cluster_degraded:<g>` /
+       `cluster_regrown:<g>` land in the run manifest and in the
+       generation history (`gen_<g>.json`).
+    4. Same membership (transient loss) or non-cluster failure: the
+       plain supervised restart with exponential backoff, bounded by
+       `max_restarts` WITHIN the current generation.
+
+    A clean exit in a reshaped run publishes the `done` marker so
+    parked hosts return 0 instead of waiting out their park deadline."""
+    from .subproc import run_contained
+    os.makedirs(os.path.dirname(failure_log) or ".", exist_ok=True)
+    cdir = cluster_dir(prefix)
+    os.makedirs(cdir, exist_ok=True)
+    interval = min(max(float(host_deadline) / 4.0, 0.1), 2.0)
+    beat = SupervisorBeat(cdir, host_id, interval)
+    beat.start()
+    cur = read_generation(cdir) or initial_generation(world_full,
+                                                      coordinator)
+    attempt = 0
+    resume = cur["generation"] > 1
+    numeric_restarts = 0
+    rc: int | None = 1
+    try:
+        while True:
+            if int(host_id) not in cur["hosts"]:
+                got = _rejoin_wait(cdir, host_id, cur["generation"],
+                                   park_deadline)
+                if got == "done":
+                    log.info("rejoin-wait: run finished without this "
+                             "host; exiting clean")
+                    return 0
+                if got is None:
+                    log.error("rejoin-wait: no generation re-admitted "
+                              "host %d within %.0fs; giving up",
+                              host_id, park_deadline)
+                    return 1
+                cur, attempt, resume = got, 0, True
+                continue
+            rank = cur["hosts"].index(int(host_id))
+            cmd = list(build_cmd(cur, rank, resume))
+            if resume and numeric_restarts \
+                    and anomaly_action == "rewind_lr":
+                cmd += ["-lr_scale",
+                        repr(anomaly_lr_mult ** numeric_restarts)]
+            child_env = dict(env if env is not None else os.environ)
+            child_env.update(
+                CAFFE_SUPERVISED_CHILD="1",
+                CAFFE_TPU_CLUSTER_DIR=cdir,
+                CAFFE_TPU_CLUSTER_GEN=str(cur["generation"]),
+                CAFFE_TPU_CLUSTER_HOSTS=",".join(
+                    str(h) for h in cur["hosts"]),
+                CAFFE_TPU_CLUSTER_SELF=str(int(host_id)),
+                CAFFE_TPU_WORLD_FULL=str(
+                    cur.get("world_full", world_full)),
+                CAFFE_TPU_CLUSTER_DEADLINE=repr(float(host_deadline)))  # lint: ok(host-sync) — host scalar knob
+            log.info("supervisor[gen %d]: attempt %d/%d as rank %d/%d: "
+                     "%s", cur["generation"], attempt + 1,
+                     max_restarts + 1, rank, cur["world"], " ".join(cmd))
+            t0 = time.time()
+            rc, out, err = run_contained(cmd, deadline, cwd=cwd,
+                                         env=child_env, echo=True)
+            dt = time.time() - t0
+            if rc == 0:
+                if cur["generation"] > 1:
+                    # release any parked excluded host. NOT
+                    # atomic_output: every finishing supervisor writes
+                    # this marker CONCURRENTLY and the stale-tmp sweep
+                    # assumes serialized writers; only the marker's
+                    # existence signals, so a plain racy write is
+                    # exactly right
+                    try:
+                        with open(os.path.join(cdir, _GEN_DONE),
+                                  "w") as f:
+                            f.write(f"{time.time()}\n")
+                    except OSError as e:
+                        log.warning("done-marker write failed "
+                                    "(a peer's likely landed): %s", e)
+                if attempt > 0 or cur["generation"] > 1:
+                    log.info("supervisor: recovered (generation %d, %d "
+                             "restart(s) in it)", cur["generation"],
+                             attempt)
+                return 0
+            reason = ("deadline" if rc is None else
+                      "watchdog" if rc == EXIT_WATCHDOG else
+                      "numeric divergence" if rc == EXIT_NUMERIC else
+                      "fault/cluster" if rc == EXIT_FAULT else
+                      f"exit {rc}")
+            with open(failure_log, "a") as f:
+                f.write(f"[{time.ctime()}] gen {cur['generation']} "
+                        f"attempt {attempt + 1}: {reason} after "
+                        f"{dt:.1f}s: {' '.join(cmd)}\n")
+                tail = (out or "").strip().splitlines()[-20:] \
+                    + (err or "").strip().splitlines()[-20:]
+                for line in tail:
+                    f.write(f"    {line}\n")
+            if rc == EXIT_NUMERIC:
+                if anomaly_action == "abort":
+                    log.error("supervisor: numeric divergence with "
+                              "anomaly_action 'abort'; not restarting "
+                              "(log: %s)", failure_log)
+                    return EXIT_NUMERIC
+                numeric_restarts += 1
+            # test-only: the whole host (supervisor included) goes dark
+            # for `arg` seconds — the survivors must degrade around it,
+            # and its revival must re-enter via rejoin-wait
+            dark = FAULTS.fire("host_perma_loss")
+            if dark is not None:
+                park = float(dark) if dark else 8.0  # lint: ok(host-sync) — fault-spec string arg
+                log.warning("fault host_perma_loss: host %d supervisor "
+                            "dark for %.1fs", host_id, park)
+                beat.pause()
+                time.sleep(park)
+                beat.resume()
+                log.warning("fault host_perma_loss: host %d supervisor "
+                            "revived", host_id)
+            newer = read_generation(cdir)
+            if newer and newer["generation"] > cur["generation"]:
+                log.info("supervisor: generation %d -> %d (published "
+                         "by a peer while this host was down)",
+                         cur["generation"], newer["generation"])
+                cur, attempt, resume = newer, 0, True
+                continue
+            if rc == EXIT_CLUSTER:
+                window = max(float(host_deadline), 8 * interval)  # lint: ok(host-sync) — host scalar knob
+                live = observe_live_hosts(cdir, world_full, host_id,
+                                          window)
+                if sorted(live) != sorted(cur["hosts"]) \
+                        and len(live) >= max(int(min_hosts), 1):
+                    if min(live) == int(host_id):
+                        g = cur["generation"] + 1
+                        event = ("cluster_degraded"
+                                 if len(live) < len(cur["hosts"])
+                                 else "cluster_regrown")
+                        # the publisher is the LOWEST survivor == the
+                        # new rank 0 == the host the new coordination
+                        # service must run on: a fresh port on its own
+                        # address is always bindable by its own worker
+                        newgen = {
+                            "generation": g, "hosts": live,
+                            "world": len(live),
+                            "world_full": int(world_full),
+                            "coordinator":
+                                f"{coordinator_host}:{_free_port()}",
+                            "reason": event,
+                            "prev_hosts": cur["hosts"]}
+                        write_generation(cdir, newgen)
+                        try:
+                            write_run_manifest(
+                                prefix, reason=f"{event}:{g}",
+                                generation=g, hosts=live,
+                                world=len(live),
+                                world_full=int(world_full))
+                        except OSError:
+                            log.exception("generation journal failed "
+                                          "(continuing)")
+                        log.warning(
+                            "supervisor: published generation %d "
+                            "(%s): hosts %s -> %s, world %d", g,
+                            event, cur["hosts"], live, len(live))
+                        cur, attempt, resume = newgen, 0, True
+                        continue
+                    got = _wait_generation_advance(
+                        cdir, cur["generation"], window + 15.0)
+                    if got is not None:
+                        cur, attempt, resume = got, 0, True
+                        continue
+                    log.warning("supervisor: membership changed (%s -> "
+                                "%s) but host %d never published a "
+                                "generation; falling back to a plain "
+                                "restart", cur["hosts"], live,
+                                min(live))
+            attempt += 1
+            if attempt > max_restarts:
+                log.error("supervisor: crash-loop guard: %d failure(s) "
+                          "in generation %d; giving up (log: %s)",
+                          attempt, cur["generation"], failure_log)
+                return 1 if rc is None else rc
+            delay = min(backoff_base * (2 ** (attempt - 1)), backoff_cap)
+            verb = ("rewinding to" if rc == EXIT_NUMERIC
+                    else "restarting from")
+            log.warning("supervisor: child failed (%s); %s the newest "
+                        "verified snapshot in %.1fs", reason, verb,
+                        delay)
+            time.sleep(delay)
+            resume = True
+    finally:
+        beat.stop()

@@ -1,0 +1,469 @@
+"""Degraded-mode elasticity suite (ISSUE 19).
+
+The reference's multi-node path dies permanently with any rank
+(clusters.cpp:8-45 — one MPI_Abort kills the job); PR 10's elastic
+restart-all survives TRANSIENT losses but blocks forever re-forming
+the original world when a host is gone for good. This suite holds the
+generation protocol that reshapes the cluster around the survivors:
+
+1. the generation store (`<prefix>.cluster/`): implicit generation 1,
+   atomic publish + history audit trail, torn-record fallback, done
+   markers
+2. supervisor-beat membership: prime-then-count liveness (a frozen
+   beat file never reads as alive), rejoin-wait parking
+3. the solver's snapshot-boundary rejoin trigger
+   (`_maybe_admit_rejoin`): min_hosts-gated, primes on first boundary,
+   raises a journaled `cluster_rejoin` ClusterError on a revival
+4. fast-fail doomed formation: consecutive fresh `cluster_init_failed`
+   journals stop the restart loop early; a cluster that formed once
+   never fast-fails
+5. stable quarantine identity: `.h<host>` journals keyed on the
+   ORIGINAL host id survive rank remaps; rank 0's merge reads both
+   stems
+6. cross-world-count snapshot restore: an ORBAX set saved on a 4-way
+   mesh restores onto 2-way and back (the degraded resume path)
+7. the e2e acceptance: tools/multihost_smoke.py --degrade (permanent
+   host-1 loss -> generation 2 at world 1 -> rejoin-wait -> snapshot-
+   boundary grow-back to generation 3 -> weights bitwise vs baseline)
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from caffe_mpi_tpu.utils import resilience
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+
+
+# ---------------------------------------------------------------------------
+# 1. generation store
+# ---------------------------------------------------------------------------
+
+class TestGenerationStore:
+    def test_initial_generation_is_implicit(self, tmp_path):
+        cdir = resilience.cluster_dir(str(tmp_path / "s"))
+        assert cdir == str(tmp_path / "s") + ".cluster"
+        assert resilience.read_generation(cdir) is None  # nothing written
+        gen = resilience.initial_generation(3, "localhost:9")
+        assert gen["generation"] == 1
+        assert gen["hosts"] == [0, 1, 2]
+        assert gen["world"] == gen["world_full"] == 3
+        assert gen["reason"] == "cluster_formed"
+
+    def test_publish_roundtrip_and_history(self, tmp_path):
+        cdir = str(tmp_path / "s.cluster")
+        gen2 = {"generation": 2, "hosts": [0, 2], "world": 2,
+                "world_full": 3, "coordinator": "localhost:7001",
+                "reason": "cluster_degraded", "prev_hosts": [0, 1, 2]}
+        resilience.write_generation(cdir, gen2)
+        got = resilience.read_generation(cdir)
+        assert got["generation"] == 2
+        assert got["hosts"] == [0, 2]
+        assert got["reason"] == "cluster_degraded"
+        assert got["time"] > 0
+        # the audit trail: per-generation history file
+        hist = json.load(open(os.path.join(cdir, "gen_2.json")))
+        assert hist["prev_hosts"] == [0, 1, 2]
+        # a later generation keeps both history files
+        resilience.write_generation(cdir, dict(
+            gen2, generation=3, hosts=[0, 1, 2], world=3,
+            reason="cluster_regrown"))
+        assert os.path.exists(os.path.join(cdir, "gen_2.json"))
+        assert resilience.read_generation(cdir)["generation"] == 3
+
+    def test_torn_record_reads_as_none(self, tmp_path):
+        cdir = str(tmp_path / "c")
+        os.makedirs(cdir)
+        with open(resilience.generation_path(cdir), "w") as f:
+            f.write('{"generation": 2, "hos')  # torn mid-write
+        assert resilience.read_generation(cdir) is None
+        with open(resilience.generation_path(cdir), "w") as f:
+            json.dump({"generation": 0, "hosts": [0]}, f)  # invalid gen
+        assert resilience.read_generation(cdir) is None
+
+    def test_new_generation_clears_stale_done_marker(self, tmp_path):
+        """A done marker from an earlier COMPLETED run under this
+        prefix must not release the next run's parked rejoiners."""
+        cdir = str(tmp_path / "c")
+        os.makedirs(cdir)
+        with open(os.path.join(cdir, "done"), "w") as f:
+            f.write("1\n")
+        resilience.write_generation(cdir, {
+            "generation": 2, "hosts": [0], "world": 1, "world_full": 2,
+            "coordinator": "x:1", "reason": "cluster_degraded"})
+        assert not os.path.exists(os.path.join(cdir, "done"))
+
+
+# ---------------------------------------------------------------------------
+# 2. supervisor-beat membership + rejoin-wait
+# ---------------------------------------------------------------------------
+
+class TestMembership:
+    def test_beating_host_is_live_frozen_host_is_not(self, tmp_path):
+        cdir = str(tmp_path / "c")
+        # host 1 beats continuously; host 2's file is FROZEN (dead
+        # incarnation's last write) — prime-then-count must admit only
+        # the beater (plus the observer itself)
+        beat = resilience.SupervisorBeat(cdir, 1, 0.05)
+        tr = resilience.DirBeatTransport(os.path.join(cdir, "hb"))
+        tr.publish(2, 41)  # frozen: never advances again
+        beat.start()
+        try:
+            live = resilience.observe_live_hosts(cdir, 3, 0, 0.6)
+        finally:
+            beat.stop()
+        assert live == [0, 1]
+
+    def test_paused_beat_goes_dark(self, tmp_path):
+        cdir = str(tmp_path / "c")
+        beat = resilience.SupervisorBeat(cdir, 1, 0.05)
+        beat.start()
+        try:
+            time.sleep(0.2)   # some beats land
+            beat.pause()
+            time.sleep(0.15)  # in-flight beat drains
+            live = resilience.observe_live_hosts(cdir, 2, 0, 0.5)
+            assert live == [0]
+            beat.resume()
+            live = resilience.observe_live_hosts(cdir, 2, 0, 0.5)
+            assert live == [0, 1]
+        finally:
+            beat.stop()
+
+    def test_rejoin_wait_readmission_and_done(self, tmp_path):
+        cdir = str(tmp_path / "c")
+        os.makedirs(cdir)
+        # a generation beyond `beyond` that includes the host releases it
+        resilience.write_generation(cdir, {
+            "generation": 3, "hosts": [0, 1], "world": 2,
+            "world_full": 2, "coordinator": "x:1",
+            "reason": "cluster_regrown"})
+        got = resilience._rejoin_wait(cdir, 1, 2, park_deadline=5.0)
+        assert got["generation"] == 3
+        # ...but one that still excludes it parks until the deadline
+        assert resilience._rejoin_wait(cdir, 5, 3,
+                                       park_deadline=0.6) is None
+        # the done marker means the run finished without this host
+        with open(os.path.join(cdir, "done"), "w") as f:
+            f.write("1\n")
+        assert resilience._rejoin_wait(cdir, 5, 3,
+                                       park_deadline=5.0) == "done"
+
+
+class TestClusterGenerationEnv:
+    """mesh.cluster_generation parses the env the elastic supervisor
+    exports per generation; mesh.publish_generation mirrors it (KV side
+    exercised by the smoke — here the parse/no-op halves)."""
+
+    def test_parse_and_absent(self, monkeypatch):
+        from caffe_mpi_tpu.parallel import mesh
+        for var in ("CAFFE_TPU_CLUSTER_GEN", "CAFFE_TPU_CLUSTER_HOSTS",
+                    "CAFFE_TPU_WORLD_FULL", "CAFFE_TPU_CLUSTER_SELF"):
+            monkeypatch.delenv(var, raising=False)
+        assert mesh.cluster_generation() is None
+        assert mesh.publish_generation() is False  # no-op outside a run
+        monkeypatch.setenv("CAFFE_TPU_CLUSTER_GEN", "2")
+        monkeypatch.setenv("CAFFE_TPU_CLUSTER_HOSTS", "0,2")
+        monkeypatch.setenv("CAFFE_TPU_WORLD_FULL", "3")
+        monkeypatch.setenv("CAFFE_TPU_CLUSTER_SELF", "2")
+        gen = mesh.cluster_generation()
+        assert gen == {"generation": 2, "hosts": [0, 2],
+                       "world_full": 3, "self": 2}
+        monkeypatch.setenv("CAFFE_TPU_CLUSTER_HOSTS", "0,x")
+        assert mesh.cluster_generation() is None  # malformed -> None
+
+
+# ---------------------------------------------------------------------------
+# 3. the solver's snapshot-boundary rejoin trigger
+# ---------------------------------------------------------------------------
+
+class TestRejoinBoundary:
+    NET = """
+    name: "lsq"
+    layer { name: "in" type: "Input" top: "x" top: "t"
+            input_param { shape { dim: 4 dim: 3 } shape { dim: 4 dim: 1 } } }
+    layer { name: "ip" type: "InnerProduct" bottom: "x" top: "pred"
+            inner_product_param { num_output: 1
+              weight_filler { type: "gaussian" std: 1 } } }
+    layer { name: "loss" type: "EuclideanLoss" bottom: "pred"
+            bottom: "t" top: "l" }
+    """
+
+    def _solver(self, min_hosts=1):
+        from caffe_mpi_tpu.proto import SolverParameter
+        from caffe_mpi_tpu.proto.config import NetParameter
+        from caffe_mpi_tpu.solver import Solver
+        sp = SolverParameter.from_text(
+            'base_lr: 0.1 max_iter: 50 lr_policy: "fixed" display: 0 '
+            f'random_seed: 3 min_hosts: {min_hosts}')
+        sp.net_param = NetParameter.from_text(self.NET)
+        return Solver(sp)
+
+    def test_unset_knob_is_inert(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("CAFFE_TPU_CLUSTER_DIR", str(tmp_path / "c"))
+        monkeypatch.setenv("CAFFE_TPU_CLUSTER_HOSTS", "0")
+        monkeypatch.setenv("CAFFE_TPU_WORLD_FULL", "2")
+        s = self._solver(min_hosts=0)
+        s._maybe_admit_rejoin()
+        assert s._rejoin is None  # never even initialized
+        s.close()
+
+    def test_full_generation_disables_check(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("CAFFE_TPU_CLUSTER_DIR", str(tmp_path / "c"))
+        monkeypatch.setenv("CAFFE_TPU_CLUSTER_HOSTS", "0,1")
+        monkeypatch.setenv("CAFFE_TPU_WORLD_FULL", "2")
+        s = self._solver()
+        s._maybe_admit_rejoin()
+        assert s._rejoin is False  # no hosts missing -> permanent no-op
+        s.close()
+
+    def test_revival_raises_cluster_rejoin_at_boundary(
+            self, tmp_path, monkeypatch):
+        """First boundary primes the missing host's (frozen) beat;
+        a later boundary that observes an ADVANCE raises the journaled
+        grow-back trigger."""
+        cdir = str(tmp_path / "c")
+        monkeypatch.setenv("CAFFE_TPU_CLUSTER_DIR", cdir)
+        monkeypatch.setenv("CAFFE_TPU_CLUSTER_HOSTS", "0")
+        monkeypatch.setenv("CAFFE_TPU_WORLD_FULL", "2")
+        tr = resilience.DirBeatTransport(os.path.join(cdir, "hb"))
+        tr.publish(1, 17)  # the dead incarnation's frozen last beat
+        s = self._solver()
+        s.sp.snapshot_prefix = str(tmp_path / "s")
+        s._maybe_admit_rejoin()            # boundary 1: primes
+        assert isinstance(s._rejoin, tuple)
+        s._maybe_admit_rejoin()            # frozen file: no advance
+        # the host revives: its supervisor's NEW incarnation beats
+        revived = resilience.DirBeatTransport(os.path.join(cdir, "hb"))
+        revived.publish(1, 0)
+        with pytest.raises(resilience.ClusterError) as ei:
+            s._maybe_admit_rejoin()
+        assert ei.value.journal_reason == "cluster_rejoin"
+        assert "snapshot boundary" in str(ei.value)
+        run = resilience.read_run_manifest(str(tmp_path / "s"))
+        assert run["reason"] == "cluster_rejoin"
+        assert run["rejoining_hosts"] == [1]
+        assert run["boundary_iter"] == 0
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# 4. fast-fail doomed formation (satellite: crash-loop-of-init guard)
+# ---------------------------------------------------------------------------
+
+def _init_fail_child(tmp_path, script):
+    """A supervised 'worker' stub: counts its invocations and runs
+    `script` (which may journal + exit like cmd_train's cluster exits
+    do)."""
+    counter = str(tmp_path / "attempts")
+    src = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from caffe_mpi_tpu.utils import resilience\n"
+        "with open(%r, 'a') as f: f.write('x')\n" % (_ROOT, counter)
+    ) + script
+    return counter, [sys.executable, "-c", src]
+
+
+class TestFastFailFormation:
+    def test_repeated_init_failure_gives_up_early(self, tmp_path):
+        """Every attempt journals a fresh cluster_init_failed: the
+        supervisor must stop after the SECOND, not burn all 5."""
+        prefix = str(tmp_path / "s")
+        counter, cmd = _init_fail_child(tmp_path, (
+            "resilience.write_run_manifest(%r, "
+            "reason='cluster_init_failed', "
+            "error='coordinator localhost:1 unreachable', "
+            "exit_code=resilience.EXIT_CLUSTER)\n"
+            "sys.exit(resilience.EXIT_CLUSTER)\n" % prefix))
+        rc = resilience.supervise(
+            cmd, cmd, 5, failure_log=prefix + ".failures.log",
+            backoff_base=0.05, journal_prefix=prefix)
+        assert rc == resilience.EXIT_CLUSTER
+        assert len(open(counter).read()) == 2  # initial + ONE retry
+
+    def test_formed_once_never_fast_fails(self, tmp_path):
+        """The first attempt fails with a NON-init reason (the cluster
+        formed, then lost a host): later init failures must get the
+        full restart budget — a restarting peer is exactly what the
+        coordinated restart waits for."""
+        prefix = str(tmp_path / "s")
+        gate = str(tmp_path / "formed_once")
+        counter, cmd = _init_fail_child(tmp_path, (
+            "import os\n"
+            "reason = 'cluster_lost' if not os.path.exists(%r) "
+            "else 'cluster_init_failed'\n"
+            "open(%r, 'w').close()\n"
+            "resilience.write_run_manifest(%r, reason=reason, "
+            "error='x', exit_code=resilience.EXIT_CLUSTER)\n"
+            "sys.exit(resilience.EXIT_CLUSTER)\n" % (gate, gate, prefix)))
+        rc = resilience.supervise(
+            cmd, cmd, 3, failure_log=prefix + ".failures.log",
+            backoff_base=0.05, journal_prefix=prefix)
+        assert rc == resilience.EXIT_CLUSTER
+        assert len(open(counter).read()) == 4  # full budget: 1 + 3
+
+    def test_stale_journal_does_not_condemn(self, tmp_path):
+        """A cluster_init_failed journal from a PREVIOUS run (stale
+        timestamp) must not trip the guard on a child that fails
+        without journaling."""
+        prefix = str(tmp_path / "s")
+        resilience.write_run_manifest(
+            prefix, reason="cluster_init_failed", error="old run",
+            exit_code=resilience.EXIT_CLUSTER)
+        time.sleep(0.05)  # ensure the manifest predates attempt t0
+        counter, cmd = _init_fail_child(
+            tmp_path, "sys.exit(resilience.EXIT_CLUSTER)\n")
+        rc = resilience.supervise(
+            cmd, cmd, 2, failure_log=prefix + ".failures.log",
+            backoff_base=0.05, journal_prefix=prefix)
+        assert rc == resilience.EXIT_CLUSTER
+        assert len(open(counter).read()) == 3  # full budget: 1 + 2
+
+
+# ---------------------------------------------------------------------------
+# 5. stable quarantine identity across rank remaps (satellite)
+# ---------------------------------------------------------------------------
+
+class TestQuarantineHostIdentity:
+    def test_host_keyed_journal_path(self, tmp_path):
+        prefix = str(tmp_path / "s")
+        # classic spellings unchanged (single-host + rank-keyed)
+        assert resilience.quarantine_journal_path(prefix) \
+            == prefix + ".quarantine.json"
+        assert resilience.quarantine_journal_path(prefix, 1, 2) \
+            == prefix + ".quarantine.r1.json"
+        # stable host identity wins over the (remappable) rank
+        assert resilience.quarantine_journal_path(prefix, 0, 2, host=2) \
+            == prefix + ".quarantine.h2.json"
+        # single-host runs stay unkeyed even with an identity
+        assert resilience.quarantine_journal_path(prefix, 0, 1, host=2) \
+            == prefix + ".quarantine.json"
+
+    def test_merge_reads_both_stems(self, tmp_path):
+        """A run that degraded mid-way leaves PRE-remap `.r<k>`
+        journals and post-remap `.h<host>` journals; rank 0's merge
+        must fold both."""
+        prefix = str(tmp_path / "s")
+        ent = lambda i: {"source": "db", "index": i, "key": "",
+                         "substitute": i + 1, "reason": "crc", "time": 0}
+        with open(prefix + ".quarantine.r1.json", "w") as f:
+            json.dump({"schema": 1, "records": [ent(3), ent(7)]}, f)
+        with open(prefix + ".quarantine.h1.json", "w") as f:
+            json.dump({"schema": 1, "records": [ent(7), ent(12)]}, f)
+        n = resilience.merge_quarantine_journals(prefix)
+        assert n == 3  # 7 deduped across the two identities
+        doc = json.load(open(prefix + ".quarantine.json"))
+        assert [e["index"] for e in doc["records"]] == [3, 7, 12]
+        assert len(doc["merged_from"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# 6. cross-world-count snapshot restore (satellite)
+# ---------------------------------------------------------------------------
+
+class TestCrossWorldRestore:
+    """The degraded resume path: rank 0 restores the last verified
+    sharded snapshot onto a SMALLER mesh (W -> W-1) and later back onto
+    the full one. restore_native builds its abstract targets from the
+    CURRENT topology's shardings, so this works by construction — held
+    here against conftest's 8 virtual CPU devices."""
+
+    NET = TestRejoinBoundary.NET
+
+    def _solver(self, n_dev):
+        import jax
+        from caffe_mpi_tpu.parallel.mesh import MeshPlan
+        from caffe_mpi_tpu.proto import SolverParameter
+        from caffe_mpi_tpu.proto.config import NetParameter
+        from caffe_mpi_tpu.solver import Solver
+        sp = SolverParameter.from_text(
+            'base_lr: 0.1 max_iter: 50 lr_policy: "fixed" display: 0 '
+            'random_seed: 3 snapshot_format: ORBAX')
+        sp.net_param = NetParameter.from_text(self.NET)
+        mesh = MeshPlan.from_shape(n_dev,
+                                   devices=jax.devices()[:n_dev])
+        return Solver(sp, mesh=mesh)
+
+    @staticmethod
+    def _feeds(it):
+        import jax.numpy as jnp
+        r = np.random.RandomState(it % 16)
+        x = r.randn(4, 3).astype(np.float32)
+        t = (x @ np.array([[1.0], [-2.0], [0.5]]) + 0.3).astype(
+            np.float32)
+        return {"x": jnp.asarray(x), "t": jnp.asarray(t)}
+
+    def test_restore_across_world_sizes(self, tmp_path):
+        prefix = str(tmp_path / "s")
+        s4 = self._solver(4)
+        s4.sp.snapshot_prefix = prefix
+        s4.step(3, self._feeds)
+        s4.snapshot()
+        s4.close()
+        w4 = np.asarray(s4.params["ip"]["weight"])
+        manifests = resilience.iter_snapshot_manifests(prefix)
+        assert manifests and manifests[0][0] == 3
+        assert resilience.verify_snapshot(manifests[0][1]) is not None
+
+        # degrade: the same set restores onto HALF the devices
+        s2 = self._solver(2)
+        s2.sp.snapshot_prefix = prefix
+        state = s2.restore_auto()
+        assert state and state.endswith("s_iter_3.orbax")
+        assert s2.iter == 3
+        assert np.array_equal(np.asarray(s2.params["ip"]["weight"]), w4)
+        # the degraded generation trains and snapshots on ITS mesh
+        s2.step(2, self._feeds)
+        s2.snapshot()
+        s2.close()
+        w2 = np.asarray(s2.params["ip"]["weight"])
+
+        # grow back: the 2-way set restores onto the full mesh
+        s4b = self._solver(4)
+        s4b.sp.snapshot_prefix = prefix
+        state = s4b.restore_auto()
+        assert state and state.endswith("s_iter_5.orbax")
+        assert s4b.iter == 5
+        assert np.array_equal(np.asarray(s4b.params["ip"]["weight"]),
+                              w2)
+        s4b.close()
+
+
+# ---------------------------------------------------------------------------
+# 7. e2e acceptance: the degrade smoke
+# ---------------------------------------------------------------------------
+
+class TestDegradedElasticity:
+    def test_permanent_loss_degrade_and_grow_back(self, tmp_path):
+        """tools/multihost_smoke.py --degrade: permanent host-1 loss
+        (worker AND supervisor dark) -> survivor publishes generation 2
+        and continues at world 1 -> revived supervisor parks in
+        rejoin-wait -> rank 0 re-admits it at a snapshot boundary ->
+        generation 3 (cluster_regrown) at world 2 -> final weights
+        bitwise-equal an uninterrupted baseline."""
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+        r = subprocess.run(
+            [sys.executable, os.path.join(_ROOT, "tools",
+                                          "multihost_smoke.py"),
+             "--json", "--degrade", "--workdir", str(tmp_path)],
+            env=env, cwd=_ROOT, capture_output=True, text=True,
+            timeout=560)
+        line = next((l for l in r.stdout.splitlines()
+                     if l.startswith('{"multihost_smoke"')), None)
+        assert line, (f"no smoke report (rc={r.returncode})\n"
+                      f"{r.stdout[-3000:]}\n{r.stderr[-2000:]}")
+        rep = json.loads(line)["multihost_smoke"]
+        assert r.returncode == 0 and rep["ok"], json.dumps(rep)[:3000]
+        assert rep["degraded_generation"]
+        assert rep["regrown_generation"]
+        assert rep["parked_in_rejoin_wait"]
+        assert rep["rejoin_at_snapshot_boundary"]
+        assert rep["weights_bitwise_equal"]

@@ -27,7 +27,12 @@ rest:
      host_loss-injected worker kill -> journaled exit-87 -> coordinated
      supervised recovery, final weights bit-identical to an
      uninterrupted baseline (tools/multihost_smoke.py)
- 11. `serve-fleet` (ISSUE 18) — 2-replica serving fleet behind the
+ 11. `train-degrade` (ISSUE 19) — degraded-mode elasticity: permanent
+     host-1 loss (worker AND supervisor) -> generation 2 continues at
+     world 1 -> revival parks in rejoin-wait -> snapshot-boundary
+     grow-back to generation 3 at world 2, weights bit-identical to
+     the uninterrupted baseline (tools/multihost_smoke.py --degrade)
+ 12. `serve-fleet` (ISSUE 18) — 2-replica serving fleet behind the
      typed-retry router: replica_dead-injected kill under live traffic
      -> typed futures, held p99, journaled death, bank-warm
      zero-compile respawn, rolling canary swap + bitwise rejection
@@ -281,6 +286,16 @@ for causal in (False, True):
             # this stage into real cross-host collectives.
             run("train-multihost",
                 [py, "tools/multihost_smoke.py", "--json"], 600, log)
+            # degraded-mode elasticity (ISSUE 19): same pair with
+            # -min_hosts 1, but host 1 dies PERMANENTLY (supervisor
+            # dark too). The survivor must publish generation 2 and
+            # continue at world 1, the revived host must park in
+            # rejoin-wait, rank 0 must re-admit it at a snapshot
+            # boundary (generation 3, world 2), and the regrown run's
+            # weights must still match the uninterrupted baseline.
+            run("train-degrade",
+                [py, "tools/multihost_smoke.py", "--json", "--degrade"],
+                600, log)
             # serving fleet (ISSUE 18, docs/serving.md "Fleet"): 2
             # replica processes behind the typed-retry router; the
             # fault plane kills one at a heartbeat boundary under live

@@ -207,6 +207,8 @@ def init_distributed(coordinator: str | None = None,
             last = e
             try:
                 jax.distributed.shutdown()
+            # lint: ok(typed-failure) — partial-init teardown; the
+            # retry loop re-raises the real failure as ClusterError
             except Exception:  # noqa: BLE001 — partial init state
                 pass
             if attempt + 1 >= max(attempts, 1):
@@ -227,6 +229,8 @@ def shutdown_distributed() -> None:
     is still mid-KV-call."""
     try:
         jax.distributed.shutdown()
+    # lint: ok(typed-failure) — already down IS the goal state; there
+    # is nothing left to type or journal after the exit barrier
     except Exception:  # noqa: BLE001 — already down is fine
         pass
 
@@ -238,6 +242,8 @@ def _cluster_client():
     try:
         from jax._src import distributed
         return distributed.global_state.client
+    # lint: ok(typed-failure) — None IS the typed answer here: no
+    # distributed runtime; every caller handles the None branch
     except Exception:  # noqa: BLE001 — no distributed runtime
         return None
 
@@ -253,6 +259,8 @@ def cluster_barrier(name: str, timeout_s: float = 600.0) -> bool:
     try:
         client.wait_at_barrier(name, int(timeout_s * 1000))
         return True
+    # lint: ok(typed-failure) — False is the typed result; callers map
+    # it to a journaled EXIT_CLUSTER (the docstring contract)
     except Exception as e:  # noqa: BLE001 — timeout and UNAVAILABLE alike
         log.error("cluster barrier %r failed: %s", name, e)
         return False
@@ -267,6 +275,8 @@ def cluster_kv_set(key: str, value: str) -> bool:
     try:
         client.key_value_set(key, value)
         return True
+    # lint: ok(typed-failure) — best-effort publish; False is the
+    # typed result the caller branches on
     except Exception as e:  # noqa: BLE001
         log.error("cluster kv set %r failed: %s", key, e)
         return False
@@ -280,6 +290,8 @@ def cluster_kv_get(key: str, timeout_s: float = 120.0) -> str | None:
         return None
     try:
         return client.blocking_key_value_get(key, int(timeout_s * 1000))
+    # lint: ok(typed-failure) — None is the typed timeout/dead-service
+    # result; callers treat it as "no decision published"
     except Exception as e:  # noqa: BLE001
         log.error("cluster kv get %r failed: %s", key, e)
         return None
@@ -316,6 +328,8 @@ class KVBeatTransport:
             try:
                 self._client.key_value_delete(
                     self._key(host, seq - self._PRUNE_LAG))
+            # lint: ok(typed-failure) — pruning is best-effort; the
+            # store stays bounded either way (readers use latest_seq)
             except Exception:  # noqa: BLE001 — pruning is best-effort
                 pass
 
@@ -338,6 +352,8 @@ class KVBeatTransport:
         try:
             self._client.blocking_key_value_get(self._key(host, "bye"), 1)
             return True
+        # lint: ok(typed-failure) — absence of the bye key IS the
+        # False answer; the KV get has no non-raising miss spelling
         except Exception:  # noqa: BLE001
             return False
 

@@ -187,7 +187,6 @@ class Batcher:
     # -- submission -----------------------------------------------------
     def submit(self, model: str, data: np.ndarray,
                raw_mode: bool = False) -> Future:
-        req = _Request(model, data, time.perf_counter(), raw=raw_mode)
         with self._cv:
             if self._stop or self._draining:
                 raise EngineClosedError("serving engine is closed")
@@ -212,6 +211,12 @@ class Batcher:
                     "request shed")
             if not self._threads:
                 self.start()
+            # the request (and its Future) is constructed only AFTER
+            # every admission raise above: a shed/closed/unhealthy exit
+            # with the future already built would strand it pending
+            # forever — the PR 7 shape future-resolution lints against
+            req = _Request(model, data, time.perf_counter(),
+                           raw=raw_mode)
             self._pending.append(req)
             self.max_queue_depth = max(self.max_queue_depth,
                                        len(self._pending))
@@ -281,6 +286,26 @@ class Batcher:
         return live
 
     def _dispatch_loop(self) -> None:
+        """Crash containment for the dispatcher worker (thread-crash):
+        a dispatcher that dies silently parks the whole backlog behind
+        a thread that no longer exists — the PR 11 wedge, as a crash.
+        A crash fails the in-flight work TYPED, journals, and
+        re-enters the loop fresh (the crash consumed at most the group
+        it was building; fail_inflight drained the backlog, so a
+        deterministic poison request cannot spin this loop)."""
+        while True:
+            try:
+                self._dispatch_forever()
+                return      # clean _stop/_draining exit
+            except Exception as e:  # the worker must not die silently
+                log.exception("serving: dispatcher crashed; failing "
+                              "in-flight requests and re-entering")
+                self.fail_inflight(EngineUnhealthyError(
+                    f"serving dispatcher crashed: {e}"))
+                self._engine._journal("serve_dispatcher_crash",
+                                      error=str(e))
+
+    def _dispatch_forever(self) -> None:
         while True:
             with self._cv:
                 while not self._pending and not self._stop:
@@ -504,6 +529,9 @@ class Batcher:
     # -- harvester ------------------------------------------------------
     def _harvest_loop(self) -> None:
         while True:
+            # lint: ok(deadline-discipline) — idle park by design:
+            # close() wakes this queue with a None sentinel, and a
+            # wedged materialization is the watchdog's job below
             item = self._harvest_q.get()
             if item is None:
                 return

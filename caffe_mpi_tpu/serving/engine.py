@@ -89,6 +89,8 @@ def _device_probe(timeout: float) -> bool:
             # a real round-trip, not just an enqueue
             np.asarray(x + 1.0)
             ok.append(True)
+        # lint: ok(typed-failure) — any failure = not recovered; the
+        # finally sets the done event the prober decision waits on
         except Exception:  # noqa: BLE001 — any failure = not recovered
             pass
         finally:
@@ -600,6 +602,8 @@ class ServingEngine:
                 ladder=self.ladder_spec,
                 max_batch=int(preprocess.get("max_batch", 0) or 0),
                 dtype=self.serve_dtype)
+        # lint: ok(typed-failure) — the plan is advisory telemetry;
+        # serving is fully correct without it (docstring contract)
         except Exception as e:  # noqa: BLE001 — plan is advisory
             log.warning("serving: static plan for %r failed (%s); "
                         "loading without one", name, e)
@@ -842,6 +846,16 @@ class ServingEngine:
             self._journal("serve_recovered", trips=self.stall_trips)
             return True
 
+    def _probe_recovery_guarded(self) -> None:
+        """Thread entry for the async recovery probe (thread-crash):
+        a probe that raises must journal, not die silently — a silent
+        death here leaves the breaker open with no operator signal."""
+        try:
+            self.probe_recovery()
+        except Exception as e:
+            log.exception("serving: recovery probe crashed")
+            self._journal("serve_probe_crash", error=str(e))
+
     def _maybe_probe_async(self) -> None:
         """Kick a background recovery probe at most once per breaker
         deadline — live traffic keeps probing a dead tunnel without any
@@ -855,7 +869,7 @@ class ServingEngine:
         # the worst a lost race costs is one redundant probe thread,
         # and probe_recovery itself serializes under _probe_lock
         self._last_probe = now
-        threading.Thread(target=self.probe_recovery, daemon=True,
+        threading.Thread(target=self._probe_recovery_guarded, daemon=True,
                          name="serve-recovery-probe").start()
 
     def note_unhealthy_shed(self) -> None:
@@ -1120,12 +1134,15 @@ class ServingEngine:
         self._shed_if_unhealthy()
         return self.submit_raw(name, self.decode_request(data))
 
-    def classify(self, name: str, imgs, *, preprocess: bool = True
-                 ) -> np.ndarray:
-        """Synchronous convenience: submit all, gather rows in order."""
+    def classify(self, name: str, imgs, *, preprocess: bool = True,
+                 timeout: float | None = 600.0) -> np.ndarray:
+        """Synchronous convenience: submit all, gather rows in order.
+        The gather is deadline-bounded (deadline-discipline): a wedged
+        dispatcher behind a dead tunnel must surface as a TimeoutError
+        here, never as an unkillable hang in the caller."""
         futures = [self.submit(name, im, preprocess=preprocess)
                    for im in imgs]
-        return np.stack([f.result() for f in futures])
+        return np.stack([f.result(timeout=timeout) for f in futures])
 
     def drain(self, timeout: float = 60.0) -> None:
         self._batcher.drain(timeout)

@@ -528,6 +528,9 @@ class ReplicaBeat:
                                         name=f"replica-beat-{self.rid}")
         self._thread.start()
 
+    # lint: ok(thread-crash) — a dead beat thread IS the failure
+    # signal: the supervisor mourns the silence within one deadline
+    # and respawns the whole replica process (docs/serving.md "Fleet")
     def _loop(self) -> None:
         while True:
             try:
@@ -709,6 +712,9 @@ class FleetSupervisor:
                     # lint: ok(host-sync) — heartbeat elapsed is a
                     # host-side monotonic delta, not a device value
                     self._handle_loss(int(peer), float(elapsed))
+            # lint: ok(typed-failure) — the supervisor must survive a
+            # failed poll; the next tick retries, and a truly dead
+            # replica keeps failing the heartbeat until handled
             except Exception:  # noqa: BLE001 — the supervisor survives
                 log.exception("fleet: supervisor poll failed "
                               "(continuing)")

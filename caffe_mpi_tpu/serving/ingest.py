@@ -245,6 +245,8 @@ def preprocess_rows(model, raws: list, ingest: RequestIngest,
                 # 200 records in 9-record windows on this 24-core host)
                 num_threads=num_threads or max(
                     1, min(n // 8, os.cpu_count() or 4)))
+        # lint: ok(typed-failure) — the batch-level reject falls back
+        # per record below, where the offender alone fails TYPED (400)
         except Exception:  # noqa: BLE001 — a batch-level reject (bad
             # array) falls back per record below, where the offender
             # fails alone

@@ -85,6 +85,9 @@ class SnapshotWatcher:
         while not self._stop.wait(self.poll_s):
             try:
                 self.check_once()
+            # lint: ok(typed-failure) — the watcher must survive a
+            # failed poll (half-written snapshot dirs); the next poll
+            # retries, and a rejected swap is journaled in check_once
             except Exception:  # noqa: BLE001 — the watcher must survive
                 log.exception("serving: snapshot watch poll failed "
                               "(continuing)")

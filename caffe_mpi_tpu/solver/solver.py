@@ -521,6 +521,9 @@ class Solver:
                 kw = dict(self._net_ctor)
                 kw["batch_divisor"] = kw["batch_divisor"] * n_data
                 self._reduction_net = Net(train_param, phase="TRAIN", **kw)
+            # lint: ok(typed-failure) — the typed outcome is the logged
+            # fallback reason (reduction stats surface it); training
+            # continues correct on the implicit GSPMD path
             except Exception as e:
                 self._reduction_net = None
                 fallback = (f"net does not divide into {n_data} "
@@ -2126,15 +2129,22 @@ class Solver:
         self._snapshot_thread.start()
         return ""
 
-    def wait_snapshots(self) -> None:
+    def wait_snapshots(self, timeout: float = 600.0) -> None:
         """Join any in-flight async snapshot (end of training / before a
         blocking snapshot of the same files). Re-raises a failed async
         write with its snapshot iteration — a checkpoint the user
         believes exists but doesn't must not exit 0, and the error must
-        name WHICH interval snapshot is missing."""
+        name WHICH interval snapshot is missing. The join is bounded
+        (deadline-discipline): a writer wedged inside a dead-tunnel
+        device fetch must fail loudly, not hang the exit path."""
         t = getattr(self, "_snapshot_thread", None)
         if t is not None and t.is_alive():
-            t.join()
+            t.join(timeout)
+            if t.is_alive():
+                raise RuntimeError(
+                    f"async snapshot writer still running after "
+                    f"{timeout:g}s — wedged device fetch? The snapshot "
+                    f"it was writing must be considered missing")
         err = getattr(self, "_snapshot_error", None)
         if err is not None:
             # lint: ok(thread-shared-mutation) — the writer thread was
@@ -2383,6 +2393,8 @@ class Solver:
             manifested.add(os.path.abspath(doc["state"]))
             try:
                 self.restore(doc["state"], verify=False)
+            # lint: ok(typed-failure) — falling back to an older
+            # verified snapshot IS the recovery path (docs/robustness)
             except Exception:
                 log.exception("verified snapshot at iter %d failed to "
                               "load; falling back", it)
@@ -2416,6 +2428,8 @@ class Solver:
                 continue
             try:
                 self.restore(path, verify=False)
+            # lint: ok(typed-failure) — falling back to an older
+            # snapshot IS the recovery path; exhaustion raises below
             except Exception:
                 log.exception("legacy snapshot %s failed to load; "
                               "falling back", path)

@@ -31,11 +31,13 @@ Framework shape:
   passes and code evolve. Passes that apply waivers themselves
   (doc-drift, knob-drift — `self_waiving = True`) are exempt.
 - CLI: `python -m caffe_mpi_tpu.tools.lint [--select P,...] [--json]
-  [--changed REF] [--no-stale] [paths...]`; default paths are the
-  shipped tree (caffe_mpi_tpu/, tools/, bench.py); `--changed REF`
-  lints only files named by `git diff --name-only REF` (plus explicit
-  paths) for fast pre-commit runs — a typo'd ref is a usage error
-  (exit 2), never a false-clean exit 0; exit 1 on any finding
+  [--changed REF] [--no-stale] [--profile] [paths...]`; default paths
+  are the shipped tree (caffe_mpi_tpu/, tools/, bench.py); `--changed
+  REF` lints only files named by `git diff --name-only REF` (plus
+  explicit paths) for fast pre-commit runs — a typo'd ref is a usage
+  error (exit 2), never a false-clean exit 0; exit 1 on any finding;
+  `--profile` reports per-pass wall-ms (and the shared-model build
+  count) so the 5 s whole-tree budget stays attributable per pass
 
 See docs/static_analysis.md for the pass catalog and how to add one.
 """
@@ -60,32 +62,69 @@ _WAIVER_RE = re.compile(r"#\s*lint:\s*ok\(([^)]*)\)")
 _LEGACY_WAIVER_RE = re.compile(r"#\s*host-sync:\s*ok")
 
 
-def extract_waivers(src: str) -> dict[int, set[str]]:
-    """{line: waived pass names} from the REAL comment tokens of `src`.
-    Tokenizing (rather than regexing whole lines) keeps waiver grammar
-    quoted inside string literals or docstrings from registering as a
-    waiver — text that merely *mentions* the grammar must not suppress
-    a finding on its statement."""
+def _waivers_in_comment(text: str) -> set[str]:
+    names: set[str] = set()
+    for m in _WAIVER_RE.finditer(text):
+        names.update(n.strip() for n in m.group(1).split(",")
+                     if n.strip())
+    if _LEGACY_WAIVER_RE.search(text):
+        names.add("host-sync")
+    return names
+
+
+def extract_waivers(src: str,
+                    tree: "ast.Module | None" = None) -> dict[int, set[str]]:
+    """{line: waived pass names} from the REAL comments of `src`.
+    Waiver grammar quoted inside string literals or docstrings must
+    NOT register as a waiver — text that merely *mentions* the grammar
+    cannot suppress a finding on its statement. With a parsed `tree`
+    the string spans come from its Constant/JoinedStr nodes (one cheap
+    line scan instead of re-tokenizing the file — the tokenizer
+    dominated the whole-tree run); without one (syntax-error files,
+    direct callers) the tokenizer remains the arbiter."""
     waivers: dict[int, set[str]] = {}
     if "lint:" not in src and "host-sync:" not in src:
-        # fast path: no waiver grammar anywhere — skip the tokenizer
-        # (it dominated the whole-tree run; most files carry no waiver)
+        # fast path: no waiver grammar anywhere
         return waivers
-    try:
-        tokens = tokenize.generate_tokens(io.StringIO(src).readline)
-        comments = [(t.start[0], t.string) for t in tokens
-                    if t.type == tokenize.COMMENT]
-    except (tokenize.TokenError, IndentationError, SyntaxError):
-        comments = []        # unparseable files surface as 'syntax'
-    for ln, text in comments:
-        names: set[str] = set()
-        for m in _WAIVER_RE.finditer(text):
-            names.update(n.strip() for n in m.group(1).split(",")
-                         if n.strip())
-        if _LEGACY_WAIVER_RE.search(text):
-            names.add("host-sync")
-        if names:
-            waivers.setdefault(ln, set()).update(names)
+    if tree is None:
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(src).readline)
+            comments = [(t.start[0], t.string) for t in tokens
+                        if t.type == tokenize.COMMENT]
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            comments = []    # unparseable files surface as 'syntax'
+        for ln, text in comments:
+            names = _waivers_in_comment(text)
+            if names:
+                waivers.setdefault(ln, set()).update(names)
+        return waivers
+    spans: list[tuple[int, int, int, int]] | None = None
+    for ln0, line in enumerate(src.splitlines()):
+        if "lint:" not in line and "host-sync:" not in line:
+            continue
+        if spans is None:
+            # string-literal spans, collected only when a candidate
+            # line exists (JoinedStr covers f-strings whole: pre-3.12
+            # their inner Constant locations are unreliable)
+            spans = [(n.lineno, n.col_offset, n.end_lineno,
+                      n.end_col_offset)
+                     for n in ast.walk(tree)
+                     if (isinstance(n, ast.Constant)
+                         and isinstance(n.value, (str, bytes)))
+                     or isinstance(n, ast.JoinedStr)]
+        ln = ln0 + 1
+        # the comment starts at the first '#' OUTSIDE every string
+        # literal; everything after it is comment text
+        idx = line.find("#")
+        while idx != -1:
+            if not any(l0 <= ln <= l1
+                       and (ln, idx) >= (l0, c0) and (ln, idx) < (l1, c1)
+                       for l0, c0, l1, c1 in spans):
+                names = _waivers_in_comment(line[idx:])
+                if names:
+                    waivers.setdefault(ln, set()).update(names)
+                break
+            idx = line.find("#", idx + 1)
     return waivers
 
 
@@ -111,6 +150,26 @@ class Finding:
                 "message": self.message, "detail": self.detail}
 
 
+def _build_index(n: ast.AST, stmt: ast.stmt | None, parent: ast.AST | None,
+                 order: list, info: dict,
+                 _iter=ast.iter_child_nodes, _stmt=ast.stmt) -> None:
+    """Recursive DFS filling FileContext._index's (order, info): one
+    append + one dict store per node keeps the whole-tree build inside
+    the 5 s lint budget (the iterative tuple-stack version cost ~2x).
+    Callers bump the recursion limit; AST depth tracks source nesting,
+    not file size."""
+    start = len(order)
+    order.append(n)
+    if isinstance(n, _stmt):
+        stmt = n
+    for c in _iter(n):
+        _build_index(c, stmt, n, order, info)
+    info[id(n)] = (start, len(order), stmt, parent)
+
+
+_EMPTY_BUCKET: list[ast.AST] = []
+
+
 class FileContext:
     """One parsed source file shared by all passes: source text, lines,
     AST (None on syntax error), and the per-line waiver map."""
@@ -127,9 +186,75 @@ class FileContext:
             self.tree = ast.parse(self.src, filename=path)
         except SyntaxError as e:
             self.syntax_error = e
-        # line -> set of pass names waived on that line (comment
-        # tokens only — quoted grammar in strings does not count)
-        self.waivers: dict[int, set[str]] = extract_waivers(self.src)
+        # line -> set of pass names waived on that line (real comments
+        # only — quoted grammar in strings does not count)
+        self.waivers: dict[int, set[str]] = extract_waivers(self.src,
+                                                            self.tree)
+        self._idx: tuple | None = None
+        self._buckets: dict[type, list[ast.AST]] | None = None
+
+    def _index(self) -> tuple:
+        """(preorder, info) — ONE DFS over the file, shared by every
+        pass: `info[id(n)] = (start, end, stmt, parent)` where
+        `preorder[start:end]` is n's whole subtree (preorder keeps
+        subtrees contiguous, unlike ast.walk's BFS), `stmt` is n's
+        nearest enclosing statement, and `parent` its AST parent.
+        Per-pass ast.walk re-traversals dominated the 5 s whole-tree
+        budget; this makes every subtree query a list slice and every
+        ancestor query a pointer chase."""
+        if self._idx is None:
+            order: list[ast.AST] = []
+            info: dict[int, tuple] = {}
+            if self.tree is not None:
+                limit = sys.getrecursionlimit()
+                sys.setrecursionlimit(max(limit, 20000))
+                try:
+                    _build_index(self.tree, None, None, order, info)
+                finally:
+                    sys.setrecursionlimit(limit)
+            self._idx = (order, info)
+        return self._idx
+
+    def by_type(self, cls: type) -> list[ast.AST]:
+        """All nodes of exact type `cls`, in preorder — built once for
+        every node class on first use, so a pass that only cares about
+        Call/Try/Attribute nodes scans thousands of nodes, not the
+        whole 200k-node tree."""
+        if self._buckets is None:
+            buckets: dict[type, list[ast.AST]] = {}
+            for n in self._index()[0]:
+                t = type(n)
+                b = buckets.get(t)
+                if b is None:
+                    buckets[t] = [n]
+                else:
+                    b.append(n)
+            self._buckets = buckets
+        return self._buckets.get(cls, _EMPTY_BUCKET)
+
+    def parent_of(self, node: ast.AST) -> ast.AST | None:
+        """AST parent of `node`, None for the root or nodes outside
+        this file's tree."""
+        rec = self._index()[1].get(id(node))
+        return rec[3] if rec is not None else None
+
+    def walk(self, node: ast.AST | None = None) -> list[ast.AST]:
+        """All nodes of `node`'s subtree (default: the whole file) in
+        DFS preorder, from the shared precomputed index. Nodes not in
+        this file's tree (synthetic wrappers) fall back to ast.walk."""
+        order, info = self._index()
+        if node is None or node is self.tree:
+            return order
+        rec = info.get(id(node))
+        if rec is None:
+            return list(ast.walk(node))
+        return order[rec[0]:rec[1]]
+
+    def stmt_of(self, node: ast.AST) -> ast.stmt | None:
+        """Nearest enclosing statement of `node` (itself if a stmt),
+        None for nodes outside this file's tree."""
+        rec = self._index()[1].get(id(node))
+        return rec[2] if rec is not None else None
 
     @property
     def rel(self) -> str:
@@ -241,8 +366,8 @@ def register(cls: type[LintPass]) -> type[LintPass]:
 def _load_passes() -> None:
     # import for side effect: each module registers its pass(es)
     from . import (concrete_init, concurrency, doc_drift,  # noqa: F401
-                   gated_imports, host_sync, knob_drift, netlint,
-                   reference_citation, traced_flow)
+                   failure_path, gated_imports, host_sync, knob_drift,
+                   netlint, reference_citation, traced_flow)
 
 
 # ---------------------------------------------------------------------------
@@ -285,16 +410,27 @@ def _bad_waiver_findings(ctx: FileContext,
 def run_lint(paths: Iterable[str] | None = None,
              select: Iterable[str] | None = None,
              root: str | None = None,
-             stale: bool = False) -> list[Finding]:
+             stale: bool = False,
+             profile: dict | None = None) -> list[Finding]:
     """Run the selected passes (default: all) over `paths` (default:
     the shipped tree under `root`). Returns waiver-filtered findings,
     ordered by path then line. `stale=True` (the CLI default; library
     default off for fixture ergonomics) additionally reports every
     waiver in the scanned files whose named pass — when selected and
     not self-waiving — no longer suppresses any finding on its
-    statement."""
+    statement. `profile`, when a dict, is filled with per-pass wall-ms
+    (`passes`), file count (`files`), total ms (`total_ms`), and the
+    number of shared concurrency-model builds this run performed
+    (`model_builds` — the interprocedural passes must share ONE)."""
+    import time
     _load_passes()
     root = root or repo_root()
+    t_run0 = time.perf_counter()
+    prof_ms: dict[str, float] = {}
+    builds0 = 0
+    if profile is not None:
+        from .concurrency import BUILD_COUNT
+        builds0 = BUILD_COUNT[0]
     if paths is None:
         # default-scan entries are filtered by existence (a fixture
         # root need not model bench.py); EXPLICIT paths must exist —
@@ -340,6 +476,7 @@ def run_lint(paths: Iterable[str] | None = None,
         ctxs.append(ctx)
         findings.extend(_bad_waiver_findings(ctx, set(REGISTRY)))
         for p in passes:
+            t0 = time.perf_counter() if profile is not None else 0.0
             for f in p.check(ctx):
                 lines = ctx.waiver_lines(f.span, p.name)
                 if lines:
@@ -347,8 +484,15 @@ def run_lint(paths: Iterable[str] | None = None,
                                    for ln in lines)
                 else:
                     findings.append(f)
+            if profile is not None:
+                prof_ms[p.name] = prof_ms.get(p.name, 0.0) \
+                    + (time.perf_counter() - t0) * 1000.0
     for p in passes:
+        t0 = time.perf_counter() if profile is not None else 0.0
         findings.extend(p.check_tree(ctxs, root))
+        if profile is not None:
+            prof_ms[p.name] = prof_ms.get(p.name, 0.0) \
+                + (time.perf_counter() - t0) * 1000.0
     # tree findings from files in ctxs honor waivers too
     by_path = {c.path: c for c in ctxs}
     kept = []
@@ -377,6 +521,14 @@ def run_lint(paths: Iterable[str] | None = None,
                             "--no-stale to silence this check)",
                             span=None, detail=name))
     findings.sort(key=lambda f: (f.path, f.line, f.pass_name))
+    if profile is not None:
+        from .concurrency import BUILD_COUNT
+        profile["passes"] = {n: round(ms, 3)
+                             for n, ms in sorted(prof_ms.items())}
+        profile["files"] = len(ctxs)
+        profile["total_ms"] = round(
+            (time.perf_counter() - t_run0) * 1000.0, 3)
+        profile["model_builds"] = BUILD_COUNT[0] - builds0
     return findings
 
 
@@ -441,6 +593,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--no-stale", action="store_true", dest="no_stale",
                     help="skip stale-waiver detection (waivers whose "
                          "pass no longer fires on their statement)")
+    ap.add_argument("--profile", action="store_true", dest="profile",
+                    help="report per-pass wall-ms (text: stderr table; "
+                         "--json: a {findings, profile} object) so the "
+                         "5 s whole-tree budget stays attributable")
     args = ap.parse_args(argv)
     if args.list_passes:
         for name in sorted(REGISTRY):
@@ -452,9 +608,16 @@ def main(argv: list[str] | None = None) -> int:
     paths = list(args.paths)
     if args.changed is not None:
         import subprocess
-        proc = subprocess.run(
-            ["git", "diff", "--name-only", args.changed, "--"],
-            cwd=root, capture_output=True, text=True)
+        try:
+            proc = subprocess.run(
+                ["git", "diff", "--name-only", args.changed, "--"],
+                cwd=root, capture_output=True, text=True, timeout=60)
+        except subprocess.TimeoutExpired:
+            # a wedged git (dead NFS, lock contention) must surface as
+            # a usage error, not hang the pre-commit hook forever
+            sys.stderr.write(f"git diff --name-only {args.changed} "
+                             "timed out after 60s\n")
+            return 2
         if proc.returncode != 0:
             # a typo'd ref MUST be a usage error, never a false-clean
             # exit 0 with zero files scanned
@@ -511,21 +674,40 @@ def main(argv: list[str] | None = None) -> int:
                   + ", " + ", ".join(MODEL_SCAN) + ")",
                   file=sys.stderr)
             return 0
+    profile = {} if args.profile else None
     try:
         findings = run_lint(paths or None, select=select, root=root,
-                            stale=not args.no_stale)
+                            stale=not args.no_stale, profile=profile)
     except (ValueError, FileNotFoundError) as e:
         print(e.args[0], file=sys.stderr)
         return 2
-    return _emit(findings, root, args.as_json)
+    return _emit(findings, root, args.as_json, profile=profile)
 
 
-def _emit(findings: list[Finding], root: str, as_json: bool) -> int:
+def _emit(findings: list[Finding], root: str, as_json: bool,
+          profile: dict | None = None) -> int:
     if as_json:
-        print(json.dumps([f.as_dict(root) for f in findings], indent=1))
+        if profile is not None:
+            # --json alone keeps the bare-array contract; --profile
+            # opts into the {findings, profile} envelope explicitly
+            print(json.dumps({"findings": [f.as_dict(root)
+                                           for f in findings],
+                              "profile": profile}, indent=1))
+        else:
+            print(json.dumps([f.as_dict(root) for f in findings],
+                             indent=1))
     else:
         for f in findings:
             print(f.format(root))
+        if profile is not None:
+            print(f"lint --profile: {profile.get('files', 0)} files, "
+                  f"{len(profile.get('passes', {}))} passes, "
+                  f"{profile.get('model_builds', 0)} shared model "
+                  f"build(s), {profile.get('total_ms', 0.0):.0f} ms "
+                  "total", file=sys.stderr)
+            for name, ms in sorted(profile.get("passes", {}).items(),
+                                   key=lambda kv: -kv[1]):
+                print(f"  {name:24s} {ms:8.1f} ms", file=sys.stderr)
     if findings:
         print(f"{len(findings)} lint finding(s)", file=sys.stderr)
         return 1

@@ -39,19 +39,19 @@ def _jnp_rooted(fn: ast.expr) -> bool:
             or full.startswith(("jax.numpy.", "jax.lax.")))
 
 
-def _traced_call_in(node: ast.expr) -> ast.Call | None:
+def _traced_call_in(subtree) -> ast.Call | None:
     """Any jnp./lax. call in the subtree (metadata helpers excluded)."""
-    for sub in ast.walk(node):
+    for sub in subtree:
         if (isinstance(sub, ast.Call) and _jnp_rooted(sub.func)
                 and sub.func.attr not in _CONCRETE_JNP):
             return sub
     return None
 
 
-def _zero_d_ctor_in(node: ast.expr) -> ast.Call | None:
+def _zero_d_ctor_in(subtree) -> ast.Call | None:
     """A 0-d jnp constructor in the subtree: jnp.zeros(()) /
     jnp.ones([]) / jnp.array(<number>)."""
-    for sub in ast.walk(node):
+    for sub in subtree:
         if not (isinstance(sub, ast.Call) and _jnp_rooted(sub.func)):
             continue
         attr = sub.func.attr
@@ -74,22 +74,10 @@ class ConcreteInitPass(LintPass):
                    "concrete scalars, not traced jnp constructors")
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
-        stmt_of: dict[int, ast.stmt] = {}
-
-        def index(node: ast.AST, stmt: ast.stmt | None) -> None:
-            for child in ast.iter_child_nodes(node):
-                s = child if isinstance(child, ast.stmt) else stmt
-                if isinstance(child, ast.Call) and s is not None:
-                    stmt_of[id(child)] = s
-                index(child, s)
-
-        index(ctx.tree, None)
-
-        for node in ast.walk(ctx.tree):
-            if not (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)):
+        for node in ctx.by_type(ast.Call):
+            if not isinstance(node.func, ast.Attribute):
                 continue
-            stmt = stmt_of.get(id(node))
+            stmt = ctx.stmt_of(node)
             span = ctx.span_of(stmt) if stmt is not None else None
             if node.func.attr == "reduce_window":
                 init = (node.args[1] if len(node.args) > 1 else
@@ -97,7 +85,7 @@ class ConcreteInitPass(LintPass):
                               if kw.arg == "init_value"), None))
                 if init is None:
                     continue
-                hit = _traced_call_in(init)
+                hit = _traced_call_in(ctx.walk(init))
                 if hit is not None:
                     yield Finding(
                         self.name, ctx.path, init.lineno,
@@ -115,7 +103,7 @@ class ConcreteInitPass(LintPass):
                               if kw.arg == "init"), None))
                 if init is None:
                     continue
-                hit = _zero_d_ctor_in(init)
+                hit = _zero_d_ctor_in(ctx.walk(init))
                 if hit is not None:
                     yield Finding(
                         self.name, ctx.path, hit.lineno,

@@ -49,6 +49,14 @@ All three are approximate BY DESIGN (they see syntax, not dynamic
 ownership): deliberate patterns — caller-holds-lock helpers, uploads
 whose serialization is the lock's very purpose — are waived in the
 diff with written reasons, per the tpulint contract.
+
+The failure-path passes (ISSUE 20, tools/lint/failure_path.py) ride
+the SAME model — `tree_model` additionally collects resolvable
+`threading.Thread(target=...)` / pool-`.submit()` targets, unbounded
+deadline-family call events (with held-lock context so a Condition's
+own `.wait()` stays sanctioned), and Future-bearing class fields —
+one build serves all seven interprocedural passes per run
+(`BUILD_COUNT` is the witness the `--profile` flag reports).
 """
 
 from __future__ import annotations
@@ -75,6 +83,40 @@ _DEVICE_KINDS = {"jax.device_put", "jax.device_get",
                  "jax.block_until_ready", ".block_until_ready()",
                  ".compile()", "np.asarray", "np.array", "numpy.asarray",
                  "numpy.array"}
+
+_FUTURE_CTORS = {"Future", "futures.Future", "concurrent.futures.Future"}
+
+_THREAD_CTORS = {"threading.Thread", "Thread"}
+
+_SUBPROCESS_CALLS = {"subprocess.run", "subprocess.check_output",
+                     "subprocess.check_call", "subprocess.call"}
+
+
+def deadline_kind(node: ast.Call, held: tuple = (),
+                  lock_id=None) -> str | None:
+    """The deadline-discipline call shapes (failure_path.py), held-lock
+    aware so a Condition's own `.wait()` under its lock stays the
+    sanctioned pattern. Shared with the module-level walk (held=()) —
+    one spelling of what counts as an unbounded block."""
+    func = node.func
+    dotted = dotted_name(func)
+    has_timeout = any(kw.arg == "timeout" for kw in node.keywords)
+    if dotted in _SUBPROCESS_CALLS:
+        return None if has_timeout else f"{dotted}(...) without timeout="
+    if not isinstance(func, ast.Attribute):
+        return None
+    attr = func.attr
+    if attr == "communicate":
+        return None if has_timeout else ".communicate() without timeout="
+    if attr in ("join", "result") and not node.args and not has_timeout:
+        return f".{attr}() without timeout"
+    if attr == "get" and not node.args and not node.keywords:
+        return ".get() without timeout"
+    if attr == "wait" and not node.args and not has_timeout:
+        if lock_id is not None and lock_id(func.value) in held:
+            return None     # Condition.wait under its own lock
+        return ".wait() without timeout"
+    return None
 
 
 class _Func:
@@ -110,6 +152,13 @@ class _Model:
         self.entries: set[tuple[str, str]] = set()
         self.properties: set[tuple[str, str]] = set()
         self.thread_closure: set[tuple] = set()
+        # failure-path facts (ISSUE 20): resolvable Thread targets /
+        # pool submit callees, deadline-family call events, and classes
+        # whose instances carry a concurrent.futures.Future field
+        self.thread_targets: list[dict] = []
+        self.deadline_events: list[dict] = []
+        self.future_fields: dict[str, str] = {}
+        self.ctxs: list[FileContext] = []
         # keys claimed by two different files — dropped before analysis
         # (no resolution beats wrong resolution)
         self._ambiguous: set[tuple] = set()
@@ -120,9 +169,11 @@ class _Model:
         for node in ctx.tree.body:
             if isinstance(node, ast.ClassDef):
                 self.classes.setdefault(node.name, ctx.path)
-                for item in ast.walk(node):
+                for item in ctx.walk(node):
                     if isinstance(item, ast.Assign):
                         self._class_assign(ctx, node.name, item)
+                    elif isinstance(item, ast.AnnAssign):
+                        self._class_ann(node.name, item)
             elif isinstance(node, ast.Assign):
                 kind = self._lock_ctor(node.value)
                 if kind:
@@ -143,10 +194,40 @@ class _Model:
                 self.locks[f"{cls}.{t.attr}"] = (kind, ctx.path,
                                                  node.lineno)
                 self.lock_attrs.setdefault(t.attr, set()).add(cls)
+            elif isinstance(value, ast.Call) and (
+                    dotted_name(value.func) or "") in _FUTURE_CTORS:
+                # `self.x = Future()` in __init__: instances of this
+                # class carry a future a drain site must own
+                self.future_fields.setdefault(cls, t.attr)
             elif isinstance(value, ast.Call) and isinstance(value.func,
                                                             ast.Name):
                 # `self.x = ClassName(...)` pins the attribute's type
                 self.attr_types.setdefault((cls, t.attr), value.func.id)
+
+    def _class_ann(self, cls: str, node: ast.AnnAssign) -> None:
+        """`future: Future = field(default_factory=Future)` (dataclass
+        field) or an annotated `self.x: Future = ...` — either makes
+        the class future-bearing for the future-resolution pass."""
+        is_future = (dotted_name(node.annotation) or "") in _FUTURE_CTORS \
+            or self._future_factory(node.value)
+        if not is_future:
+            return
+        t = node.target
+        if isinstance(t, ast.Name):
+            self.future_fields.setdefault(cls, t.id)
+        elif isinstance(t, ast.Attribute) and isinstance(
+                t.value, ast.Name) and t.value.id == "self":
+            self.future_fields.setdefault(cls, t.attr)
+
+    @staticmethod
+    def _future_factory(value) -> bool:
+        if not isinstance(value, ast.Call):
+            return False
+        if (dotted_name(value.func) or "").rsplit(".", 1)[-1] != "field":
+            return False
+        return any(kw.arg == "default_factory"
+                   and (dotted_name(kw.value) or "") in _FUTURE_CTORS
+                   for kw in value.keywords)
 
     @staticmethod
     def _lock_ctor(value) -> str | None:
@@ -308,7 +389,7 @@ class _FuncWalk:
 
     def run(self) -> None:
         # pre-scan simple local aliases: `x = self.attr` / `x = Cls(..)`
-        for node in ast.walk(self.fn.node):
+        for node in self.fn.ctx.walk(self.fn.node):
             if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                     and isinstance(node.targets[0], ast.Name):
                 name = node.targets[0].id
@@ -365,6 +446,13 @@ class _FuncWalk:
             key = (("mod", self.fn.stem), func.id)
             return key if key in self.m.funcs else None
         return None
+
+    def _ref(self, expr) -> tuple | None:
+        """Resolve a bare function REFERENCE (a Thread target, a pool
+        submit callee) the same way `_callee` resolves a call's func.
+        Unresolvable references (locals, closures, foreign objects)
+        return None — no resolution beats wrong resolution."""
+        return self._callee(expr)
 
     # -- the walk -------------------------------------------------------
     def _walk(self, node, held: tuple, stmt) -> None:
@@ -457,6 +545,35 @@ class _FuncWalk:
                 self.m.blocking.append({
                     "kind": kind, "held": held, "ctx": self.fn.ctx,
                     "stmt": stmt, "line": node.lineno, "func": self.key})
+        # failure-path collection (ISSUE 20): Thread targets, pool
+        # submit callees, deadline-family events — same single walk
+        if dotted_name(func) in _THREAD_CTORS:
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = self._ref(kw.value)
+                    if target is not None:
+                        self.m.thread_targets.append({
+                            "target": target, "ctx": self.fn.ctx,
+                            "stmt": stmt, "line": node.lineno,
+                            "via": "threading.Thread(target=...)",
+                            "discarded": False})
+        if isinstance(func, ast.Attribute) and func.attr == "submit" \
+                and node.args:
+            target = self._ref(node.args[0])
+            if target is not None:
+                # a DISCARDED submit future swallows the callee's
+                # exception; a kept future carries it to .result()
+                discarded = isinstance(stmt, ast.Expr) \
+                    and stmt.value is node
+                self.m.thread_targets.append({
+                    "target": target, "ctx": self.fn.ctx, "stmt": stmt,
+                    "line": node.lineno, "via": ".submit(...)",
+                    "discarded": discarded})
+        dkind = deadline_kind(node, held, self._lock_id)
+        if dkind:
+            self.m.deadline_events.append({
+                "kind": dkind, "ctx": self.fn.ctx, "stmt": stmt,
+                "line": node.lineno, "func": self.key})
 
     def _blocking_kind(self, node: ast.Call, held: tuple) -> str | None:
         func = node.func
@@ -514,10 +631,16 @@ class _FuncWalk:
 # prevents id-reuse across runs (tests edit files between runs)
 _CACHE: list = [None, None]     # [ctxs_list, model]
 
+# how many times the model was actually built (not served from cache)
+# since import — `--profile` reports the per-run delta so the
+# one-build-for-all-interprocedural-passes claim stays testable
+BUILD_COUNT = [0]
+
 
 def tree_model(ctxs: list[FileContext], root: str) -> _Model:
     if _CACHE[0] is ctxs:
         return _CACHE[1]
+    BUILD_COUNT[0] += 1
     model = _Model()
     by_path = {c.path: c for c in ctxs}
     scan_ctxs: list[FileContext] = []
@@ -545,6 +668,7 @@ def tree_model(ctxs: list[FileContext], root: str) -> _Model:
         if ctx.path not in seen and ctx.tree is not None:
             seen.add(ctx.path)
             scan_ctxs.append(ctx)
+    model.ctxs = scan_ctxs
     for ctx in scan_ctxs:
         model.scan_decls(ctx)
         model.collect_funcs(ctx)

@@ -75,14 +75,14 @@ def _registry_sites(path: str) -> tuple[dict[str, tuple[int, str]], int]:
     return {}, 0
 
 
-def _stmt_spans(tree: ast.Module | None) -> dict[int, tuple[int, int]]:
+def _stmt_spans(nodes) -> dict[int, tuple[int, int]]:
     """{line: (start, end) of the innermost statement covering it} —
     lets waivers honor the whole statement span for multi-line calls,
-    matching FileContext.span_of. Empty for unparseable files."""
+    matching FileContext.span_of. Takes a node iterable (ctx.walk() or
+    ast.walk(tree)); in both, inner statements come after their parents
+    and overwrite. Empty for unparseable files (nodes=())."""
     spans: dict[int, tuple[int, int]] = {}
-    if tree is None:
-        return spans
-    for node in ast.walk(tree):  # BFS: inner statements overwrite
+    for node in nodes:
         if isinstance(node, ast.stmt):
             end = getattr(node, "end_lineno", node.lineno) or node.lineno
             for ln in range(node.lineno, end + 1):
@@ -155,14 +155,14 @@ def _doc_exit_table(path: str) -> dict[str, tuple[int, int]]:
     return out
 
 
-def _exit_call_violations(tree: ast.Module, exits: dict,
+def _exit_call_violations(nodes, exits: dict,
                           codes: set[int]) -> list[tuple[int, str]]:
     """(line, message) for each sys.exit/os._exit call whose argument
     is a bare literal matching a registered code (operators grep for
     the symbol, not the number) or an EXIT_* symbol the registry no
     longer defines (a rename that missed a call site)."""
     out: list[tuple[int, str]] = []
-    for node in ast.walk(tree):
+    for node in nodes:
         if not isinstance(node, ast.Call) or not node.args:
             continue
         fn = node.func
@@ -245,16 +245,18 @@ class DocDriftPass(LintPass):
                 continue
             for fp in iter_py_files([path]):
                 ctx = by_path.get(os.path.abspath(fp))
-                if ctx is not None:   # already read+tokenized+parsed
+                if ctx is not None:   # already read+indexed+parsed
                     src, waivers = ctx.src, ctx.waivers
-                    spans = _stmt_spans(ctx.tree)
+                    nodes = ctx.walk() if ctx.tree is not None else ()
                 else:
                     src = open(fp, encoding="utf-8").read()
                     waivers = extract_waivers(src)
                     try:
-                        spans = _stmt_spans(ast.parse(src))
+                        nodes = list(ast.walk(ast.parse(src)))
                     except SyntaxError:
-                        spans = {}
+                        nodes = ()
+                spans = None    # built on first match — most files
+                                # have no FAULTS call site at all
                 lines = src.splitlines()
                 # whole-text scan: `fire(\n  "site")` wraps across
                 # lines and a per-line findall would miss it (the
@@ -262,6 +264,8 @@ class DocDriftPass(LintPass):
                 for m in _CALL_RE.finditer(src):
                     site = m.group(1)
                     ln = src.count("\n", 0, m.start()) + 1
+                    if spans is None:
+                        spans = _stmt_spans(nodes)
                     # waiver honored across the enclosing statement's
                     # span or the comment block directly above (same
                     # contract as FileContext.waiver_lines)
@@ -379,10 +383,14 @@ class DocDriftPass(LintPass):
                 if tree is None or not any(h in src
                                            for h in _EXIT_CALL_HINT):
                     continue
-                spans = _stmt_spans(tree)
+                nodes = (ctx.walk() if ctx is not None
+                         else list(ast.walk(tree)))
+                viols = _exit_call_violations(nodes, exits, codes)
+                if not viols:
+                    continue
+                spans = _stmt_spans(nodes)
                 lines = src.splitlines()
-                for viol_line, msg in _exit_call_violations(tree, exits,
-                                                            codes):
+                for viol_line, msg in viols:
                     if not _waived_at(self.name, viol_line, spans,
                                       waivers, lines):
                         yield Finding(self.name, fp, viol_line, msg,

@@ -74,63 +74,61 @@ class HostSyncPass(LintPass):
                    "loop — one tunnel RTT per iteration")
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
-        findings: list[Finding] = []
+        # candidate-first: scan the shared Call bucket, then climb
+        # ancestors only for the handful of matching calls — the old
+        # full-tree recursion (2 frames/node) dominated the 5 s
+        # whole-tree budget
+        for node in ctx.by_type(ast.Call):
+            kind = call_kind(node)
+            if kind is None:
+                continue
+            if self._loop_depth(ctx, node) > 0:
+                stmt = ctx.stmt_of(node)
+                yield Finding(
+                    self.name, ctx.path, node.lineno,
+                    f"{kind} inside a loop — a device value here "
+                    "costs one tunnel RTT per iteration; keep it "
+                    "on device, or waive with "
+                    "`# lint: ok(host-sync) — reason` if the sync "
+                    "is deliberate and boundary-rate (or the "
+                    "operand is host data)",
+                    span=(ctx.span_of(stmt) if stmt is not None
+                          else None),
+                    detail=kind)
 
-        def visit(node: ast.AST, depth: int,
-                  stmt: ast.stmt | None) -> None:
-            """Process `node` at loop depth `depth` (already includes
-            this node's own loop contribution), then its children."""
-            if isinstance(node, ast.stmt):
-                stmt = node
-            if depth > 0 and isinstance(node, ast.Call):
-                kind = call_kind(node)
-                if kind is not None:
-                    findings.append(Finding(
-                        self.name, ctx.path, node.lineno,
-                        f"{kind} inside a loop — a device value here "
-                        "costs one tunnel RTT per iteration; keep it "
-                        "on device, or waive with "
-                        "`# lint: ok(host-sync) — reason` if the sync "
-                        "is deliberate and boundary-rate (or the "
-                        "operand is host data)",
-                        span=(ctx.span_of(stmt) if stmt is not None
-                              else None),
-                        detail=kind))
-            if isinstance(node, (ast.For, ast.AsyncFor)):
-                # the iterable is evaluated ONCE, before the first
-                # iteration — only target/body/orelse run per pass.
-                # descend (not visit): a comprehension AS the iterable
-                # still loops over its own elements
-                descend(node.iter, depth - 1, stmt)
-                for child in [node.target, *node.body, *node.orelse]:
-                    descend(child, depth, stmt)
-                return
-            if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
-                                 ast.GeneratorExp)):
-                # ditto the first generator's source sequence
-                gen0 = node.generators[0]
-                descend(gen0.iter, depth - 1, stmt)
-                rest = [gen0.target, *gen0.ifs, *node.generators[1:]]
-                if isinstance(node, ast.DictComp):
-                    rest += [node.key, node.value]
-                else:
-                    rest.append(node.elt)
-                for child in rest:
-                    descend(child, depth, stmt)
-                return
-            for child in ast.iter_child_nodes(node):
-                descend(child, depth, stmt)
-
-        def descend(child: ast.AST, depth: int,
-                    stmt: ast.stmt | None) -> None:
-            if isinstance(child, _SCOPES):
-                # a def/lambda body is a new dynamic scope — loop
-                # depth does not carry into it
-                visit(child, 0, stmt)
-            else:
-                visit(child,
-                      depth + (1 if isinstance(child, _LOOPS) else 0),
-                      stmt)
-
-        visit(ctx.tree, 0, None)
-        yield from findings
+    @staticmethod
+    def _loop_depth(ctx: FileContext, node: ast.Call) -> int:
+        """Dynamic loop depth of `node`: loop ancestors below the
+        nearest enclosing def/lambda, minus loops whose evaluated-once
+        iterable subtree contains `node` (a For's `iter` and a
+        comprehension's first-generator source run before the first
+        iteration, so they sit one level OUTSIDE their own loop)."""
+        depth = 0
+        child, parent = node, ctx.parent_of(node)
+        while parent is not None:
+            if isinstance(parent, _SCOPES):
+                break
+            if isinstance(parent, ast.While):
+                # everything under a while — test included — runs per
+                # iteration
+                depth += 1
+            elif isinstance(parent, (ast.For, ast.AsyncFor)):
+                if child is not parent.iter:
+                    depth += 1
+            elif isinstance(parent, (ast.ListComp, ast.SetComp,
+                                     ast.DictComp, ast.GeneratorExp)):
+                gen0 = parent.generators[0]
+                # `child` here is the comprehension field holding us —
+                # the generators are not AST nodes, so the parent chain
+                # jumps straight from iter/target/elt to the comp node;
+                # containment in gen0.iter decides the evaluated-once
+                # exemption
+                it = gen0.iter
+                rec = ctx._index()[1]
+                me, span = rec.get(id(node)), rec.get(id(it))
+                inside_iter = (me is not None and span is not None
+                               and span[0] <= me[0] < span[1])
+                if not inside_iter:
+                    depth += 1
+            child, parent = parent, ctx.parent_of(parent)
+        return depth

@@ -103,24 +103,26 @@ def _mentions(src: str, knob: str) -> bool:
     return knob in src
 
 
-def _reads(tree: ast.Module | None) -> set[str]:
+def _reads(attrs, calls) -> set[str]:
     """Names the AST READS: Load-context `x.attr` attribute accesses,
     plus string literals passed as call arguments (getattr(sp, "knob"),
     sp.has("knob")). Store/Del-context attributes (`sp.knob = args.knob`
     — plumbing) and bare strings outside a call (docstrings, registry
-    tuples) are excluded. One walk per file serves every knob — the
-    per-knob rewalk made this the most expensive pass in the suite."""
+    tuples) are excluded. Takes Attribute and Call node iterables
+    (ctx.by_type buckets, or filtered ast.walk); one scan per file
+    serves every knob."""
     reads: set[str] = set()
-    if tree is None:
-        return reads
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Attribute) and isinstance(
-                node.ctx, ast.Load):
+    for node in attrs:
+        if isinstance(node.ctx, ast.Load):
             reads.add(node.attr)
-        elif isinstance(node, ast.Call):
-            for a in list(node.args) + [kw.value for kw in node.keywords]:
-                if isinstance(a, ast.Constant) and isinstance(a.value, str):
-                    reads.add(a.value)
+    for node in calls:
+        for a in node.args:
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                reads.add(a.value)
+        for kw in node.keywords:
+            a = kw.value
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                reads.add(a.value)
     return reads
 
 
@@ -161,14 +163,20 @@ class KnobDriftPass(LintPass):
                     break
                 ctx = by_path.get(os.path.abspath(fp))
                 if ctx is not None:
-                    tree = ctx.tree
+                    if ctx.tree is None:
+                        continue
+                    reads = _reads(ctx.by_type(ast.Attribute),
+                                   ctx.by_type(ast.Call))
                 else:
                     try:
-                        tree = ast.parse(
-                            open(fp, encoding="utf-8").read())
+                        nodes = list(ast.walk(ast.parse(
+                            open(fp, encoding="utf-8").read())))
                     except SyntaxError:
                         continue
-                reads = _reads(tree)
+                    reads = _reads(
+                        (n for n in nodes
+                         if isinstance(n, ast.Attribute)),
+                        (n for n in nodes if isinstance(n, ast.Call)))
                 consumed.update(k for k in KNOBS if k in reads)
 
         cfg_ctx = by_path.get(os.path.abspath(cfg_path))

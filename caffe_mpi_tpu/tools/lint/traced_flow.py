@@ -55,6 +55,15 @@ _CONCRETE_ATTRS = {"issubdtype", "iinfo", "finfo", "result_type",
 
 _FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
 
+# substrings at least one of which must appear in a file's source for
+# any root to exist there (every _TRANSFORMS/_LAX_FLOW spelling is a
+# literal identifier in the call or decorator; `lax.map` needs a
+# lax./jax. attribute root, so only the dotted forms are listed —
+# `scan` also covers associative_scan)
+_PREGATE_TOKENS = tuple(_TRANSFORMS) + (
+    "scan", "cond", "while_loop", "switch", "fori_loop",
+    "lax.map", "jax.map")
+
 
 def _is_traced_namespace_call(node: ast.expr) -> ast.Call | None:
     """The first jnp./lax. call in the subtree that produces a traced
@@ -81,6 +90,15 @@ class TracedControlFlowPass(LintPass):
                    "inside traced (jit/scan) functions")
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # pregate: a finding needs a jnp./lax./jax.-rooted call (those
+        # names appear literally in source) AND a traced root, whose
+        # transform name does too — skip the two tree recursions for
+        # files that can't possibly fire
+        src = ctx.src
+        if "jnp" not in src and "lax" not in src and "jax" not in src:
+            return
+        if not any(t in src for t in _PREGATE_TOKENS):
+            return
         # ---- collect function definitions + call edges + roots -------
         funcs: list[dict] = []          # {node, name, calls}
         roots: set[int] = set()         # id(node) of traced roots

@@ -1079,6 +1079,9 @@ class DispatchWatchdog:
             if self.pulse is not None:
                 try:
                     self.pulse()
+                # lint: ok(typed-failure) — the watchdog must survive
+                # a bad pulse callback; its deadline check below is
+                # the load-bearing path and still runs this tick
                 except Exception:
                     log.exception("watchdog: pulse callback failed "
                                   "(continuing)")
@@ -1107,6 +1110,8 @@ class DispatchWatchdog:
             try:
                 if self.on_timeout is not None:
                     self.on_timeout(label, elapsed)
+            # lint: ok(typed-failure) — the trip proceeds regardless:
+            # journaling is best-effort at death, exit 86 is the signal
             except Exception:
                 log.exception("watchdog: run-state journal failed")
             self.tripped = (label, elapsed)
@@ -1262,6 +1267,9 @@ class HostHeartbeat:
             self._last_pub = now
             try:
                 self.transport.publish(self.host, self._seq)
+            # lint: ok(typed-failure) — publish failure == silence; the
+            # peers' deadline clocks decide (the typed outcome is their
+            # journaled exit 87, not anything this host could raise)
             except Exception as e:
                 if not self._pub_warned:
                     self._pub_warned = True
@@ -1284,6 +1292,8 @@ class HostHeartbeat:
                 if seq > self._last_seq[p]:
                     self._last_seq[p] = seq
                     got = True
+            # lint: ok(typed-failure) — KV errors == silence; the
+            # deadline clock decides and trips typed below
             except Exception:
                 pass  # KV errors == silence; the deadline clock decides
             now = time.monotonic()
@@ -1296,6 +1306,8 @@ class HostHeartbeat:
                     log.info("heartbeat: host %d finished cleanly", p)
                     self._done.add(p)
                     continue
+            # lint: ok(typed-failure) — a failed bye-probe == not a
+            # clean departure; the deadline clock trips typed below
             except Exception:
                 pass
             allowance = self.deadline + (self.grace if self._first[p]
@@ -1313,6 +1325,8 @@ class HostHeartbeat:
         try:
             if self.on_lost is not None:
                 self.on_lost(peer, elapsed)
+        # lint: ok(typed-failure) — the trip proceeds regardless:
+        # journaling is best-effort at death, exit 87 is the signal
         except Exception:
             log.exception("heartbeat: host-lost journal failed")
         if self.hard_exit:
@@ -1342,6 +1356,9 @@ class HostHeartbeat:
         instead of tripping on post-training shutdown skew."""
         try:
             self.transport.farewell(self.host)
+        # lint: ok(typed-failure) — best-effort: the exit barrier
+        # already synchronized, so a lost farewell costs at worst one
+        # spurious peer deadline during shutdown skew
         except Exception:
             pass  # best-effort: the exit barrier already synchronized
 
@@ -1618,6 +1635,10 @@ class SupervisorBeat:
     def start(self) -> None:
         self._thread.start()
 
+    # lint: ok(thread-crash) — a silent supervisor beat IS the loss
+    # signal: peers mourn the silence and the membership round decides
+    # (a crashed beat thread and a dead supervisor look identical by
+    # design, and both resolve through the same degraded-mode path)
     def _run(self) -> None:
         while not self._stop.is_set():
             if not self._paused.is_set():

@@ -25,7 +25,10 @@ ALL_PASSES = ("host-sync", "traced-control-flow", "concrete-init",
               "thread-shared-mutation",
               # ISSUE 15: model-level passes (tests/test_netlint.py)
               "net-wiring", "net-shape", "net-params", "net-dtype",
-              "net-serve", "net-footprint")
+              "net-serve", "net-footprint",
+              # ISSUE 20: failure-path family
+              "future-resolution", "typed-failure", "thread-crash",
+              "deadline-discipline")
 
 
 def _write(tmp_path, name, src):
@@ -53,9 +56,9 @@ def test_all_tentpole_passes_registered():
         assert name in lint.REGISTRY, name
         assert lint.REGISTRY[name].description
     # the documented suite size (CLAUDE.md / docs/static_analysis.md):
-    # ten code passes + six net-* model passes, nothing registered
-    # twice or forgotten
-    assert len(lint.REGISTRY) == 16, sorted(lint.REGISTRY)
+    # ten code passes + six net-* model passes + the four ISSUE 20
+    # failure-path passes, nothing registered twice or forgotten
+    assert len(lint.REGISTRY) == 20, sorted(lint.REGISTRY)
 
 
 def test_shipped_tree_is_clean_fast_and_jax_free():
@@ -1438,3 +1441,529 @@ def test_changed_mode_skips_files_outside_the_scanned_tree(monkeypatch):
     monkeypatch.setattr(subprocess, "run", fake_run)
     assert lint.main(["--changed", "HEAD", "--select", "host-sync",
                       "--no-stale"]) == 0
+
+
+def test_changed_mode_wedged_git_is_usage_error(monkeypatch):
+    """A git that never answers (dead NFS, lock contention) must turn
+    into exit 2, not hang the pre-commit hook forever — the diff query
+    itself obeys deadline discipline."""
+    import subprocess
+
+    real_run = subprocess.run
+
+    def fake_run(cmd, **kw):
+        if cmd[:3] == ["git", "diff", "--name-only"]:
+            raise subprocess.TimeoutExpired(cmd, kw.get("timeout", 60))
+        return real_run(cmd, **kw)
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    assert lint.main(["--changed", "HEAD", "--no-stale"]) == 2
+
+
+def test_precommit_script_propagates_typod_ref_exit_2():
+    """tools/precommit.sh (ISSUE 20 satellite) rides tpulint's
+    --changed contract: a typo'd ref exits 2 through the whole script
+    (set -e stops before pytest ever runs) — never a false-clean 0."""
+    r = subprocess.run(
+        ["sh", os.path.join(_ROOT, "tools", "precommit.sh"),
+         "no-such-ref-xyz"],
+        capture_output=True, text=True, timeout=120, cwd=_ROOT)
+    assert r.returncode == 2, (r.stdout, r.stderr)
+
+
+# ---------------------------------------------------------------------------
+# failure-path family (ISSUE 20): future-resolution
+
+def test_future_resolution_catches_pr7_create_then_raise(tmp_path):
+    """The PR 7 regression shape: Batcher.submit created the Future
+    BEFORE the admission checks, so a shed/closed raise left the caller
+    holding a reference nobody would ever resolve."""
+    p = _write(tmp_path, "caffe_mpi_tpu/serving/batching.py", """
+        from concurrent.futures import Future
+
+        class Batcher:
+            def submit(self, item, closed, backlog, limit):
+                fut = Future()
+                if closed:
+                    raise RuntimeError("engine closed")
+                if backlog > limit:
+                    raise RuntimeError("shed")
+                self._queue.append((item, fut))
+                return fut
+    """)
+    findings = _run([p], ["future-resolution"], root=str(tmp_path))
+    # one finding per stranded future (the first raise edge reports
+    # it; linear flow then treats it as judged)
+    assert len(findings) == 1
+    assert "PR 7" in findings[0].message
+    assert "'fut'" in findings[0].message
+
+
+def test_future_resolution_clean_when_created_after_admission(tmp_path):
+    """The shipped fix for the PR 7 shape: run every raise-path check
+    first, create the Future only once admission is certain."""
+    p = _write(tmp_path, "caffe_mpi_tpu/serving/batching.py", """
+        from concurrent.futures import Future
+
+        class Batcher:
+            def submit(self, item, closed, backlog, limit):
+                if closed:
+                    raise RuntimeError("engine closed")
+                if backlog > limit:
+                    raise RuntimeError("shed")
+                fut = Future()
+                self._queue.append((item, fut))
+                return fut
+    """)
+    assert _run([p], ["future-resolution"], root=str(tmp_path)) == []
+
+
+def test_future_resolution_resolved_on_error_path_is_clean(tmp_path):
+    p = _write(tmp_path, "caffe_mpi_tpu/serving/batching.py", """
+        from concurrent.futures import Future
+
+        class Batcher:
+            def submit(self, item):
+                fut = Future()
+                try:
+                    self._enqueue(item, fut)
+                except Exception as e:
+                    fut.set_exception(e)
+                    raise
+                return fut
+    """)
+    assert _run([p], ["future-resolution"], root=str(tmp_path)) == []
+
+
+def test_future_resolution_out_of_scope_path_is_clean(tmp_path):
+    """The pass is scoped to serving/ + solver/ — a data-pipeline
+    helper juggling futures is not on the request path."""
+    p = _write(tmp_path, "caffe_mpi_tpu/data/feeder.py", """
+        from concurrent.futures import Future
+
+        def stage(closed):
+            fut = Future()
+            if closed:
+                raise RuntimeError("closed")
+            return fut
+    """)
+    assert _run([p], ["future-resolution"], root=str(tmp_path)) == []
+
+
+def test_future_resolution_honors_waiver(tmp_path):
+    p = _write(tmp_path, "caffe_mpi_tpu/serving/batching.py", """
+        from concurrent.futures import Future
+
+        class Batcher:
+            def submit(self, item, closed):
+                fut = Future()
+                if closed:
+                    # lint: ok(future-resolution) — fixture: ownership
+                    # is provably elsewhere in this contrived shape
+                    raise RuntimeError("closed")
+                self._queue.append(fut)
+                return fut
+    """)
+    assert _run([p], ["future-resolution"], root=str(tmp_path)) == []
+
+
+def test_future_resolution_stale_waiver_reported(tmp_path):
+    p = _write(tmp_path, "caffe_mpi_tpu/serving/batching.py", """
+        from concurrent.futures import Future
+
+        class Batcher:
+            def submit(self, item):
+                # lint: ok(future-resolution) — fixture: nothing fires
+                fut = Future()
+                self._queue.append(fut)
+                return fut
+    """)
+    findings = lint.run_lint([p], select=["future-resolution"],
+                             root=str(tmp_path), stale=True)
+    assert len(findings) == 1
+    assert findings[0].pass_name == "stale-waiver"
+    assert "future-resolution" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# failure-path family (ISSUE 20): typed-failure
+
+def test_typed_failure_catches_log_and_continue(tmp_path):
+    p = _write(tmp_path, "caffe_mpi_tpu/solver/loop.py", """
+        import logging
+        log = logging.getLogger(__name__)
+
+        def step(net):
+            try:
+                net.dispatch()
+            except Exception:
+                log.warning("dispatch failed")
+    """)
+    findings = _run([p], ["typed-failure"], root=str(tmp_path))
+    assert len(findings) == 1
+    assert "swallows the failure UNTYPED" in findings[0].message
+
+
+def test_typed_failure_bare_except_pass_fails(tmp_path):
+    p = _write(tmp_path, "caffe_mpi_tpu/serving/router.py", """
+        def route(req, engine):
+            try:
+                return engine.submit(req)
+            except:
+                pass
+    """)
+    findings = _run([p], ["typed-failure"], root=str(tmp_path))
+    assert len(findings) == 1
+    assert "bare except" in findings[0].message
+
+
+def test_typed_failure_reraise_and_journal_are_clean(tmp_path):
+    p = _write(tmp_path, "caffe_mpi_tpu/serving/router.py", """
+        def route(req, engine):
+            try:
+                return engine.submit(req)
+            except Exception as e:
+                engine.journal("route_failed", error=str(e))
+
+        def close(engine):
+            try:
+                engine.drain()
+            except Exception:
+                raise
+    """)
+    assert _run([p], ["typed-failure"], root=str(tmp_path)) == []
+
+
+def test_typed_failure_resolving_future_with_error_is_clean(tmp_path):
+    p = _write(tmp_path, "caffe_mpi_tpu/serving/router.py", """
+        def route(req, fut, engine):
+            try:
+                fut.set_result(engine.submit(req))
+            except Exception as e:
+                fut.set_exception(e)
+    """)
+    assert _run([p], ["typed-failure"], root=str(tmp_path)) == []
+
+
+def test_typed_failure_out_of_scope_path_is_clean(tmp_path):
+    p = _write(tmp_path, "caffe_mpi_tpu/data/reader.py", """
+        def read(db):
+            try:
+                return db.get()
+            except Exception:
+                return None
+    """)
+    assert _run([p], ["typed-failure"], root=str(tmp_path)) == []
+
+
+def test_typed_failure_honors_waiver(tmp_path):
+    p = _write(tmp_path, "caffe_mpi_tpu/parallel/mesh_fx.py", """
+        def teardown(svc):
+            try:
+                svc.shutdown()
+            # lint: ok(typed-failure) — fixture: already-down IS the
+            # goal state of a teardown
+            except Exception:
+                pass
+    """)
+    assert _run([p], ["typed-failure"], root=str(tmp_path)) == []
+
+
+def test_typed_failure_stale_waiver_reported(tmp_path):
+    p = _write(tmp_path, "caffe_mpi_tpu/parallel/mesh_fx.py", """
+        def teardown(svc):
+            try:
+                svc.shutdown()
+            # lint: ok(typed-failure) — fixture: nothing fires here
+            except Exception:
+                raise
+    """)
+    findings = lint.run_lint([p], select=["typed-failure"],
+                             root=str(tmp_path), stale=True)
+    assert len(findings) == 1
+    assert findings[0].pass_name == "stale-waiver"
+    assert "typed-failure" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# failure-path family (ISSUE 20): thread-crash
+
+def test_thread_crash_catches_unguarded_target(tmp_path):
+    p = _write(tmp_path, "caffe_mpi_tpu/serving/monitor.py", """
+        import threading
+
+        class Monitor:
+            def start(self):
+                threading.Thread(target=self._loop,
+                                 daemon=True).start()
+
+            def _loop(self):
+                while True:
+                    self.poll()
+    """)
+    findings = _run([p], ["thread-crash"], root=str(tmp_path))
+    assert len(findings) == 1
+    assert "kills the worker SILENTLY" in findings[0].message
+    assert "_loop" in findings[0].message
+
+
+def test_thread_crash_guarded_target_is_clean(tmp_path):
+    p = _write(tmp_path, "caffe_mpi_tpu/serving/monitor.py", """
+        import threading
+
+        class Monitor:
+            def start(self):
+                threading.Thread(target=self._loop,
+                                 daemon=True).start()
+
+            def _loop(self):
+                try:
+                    while True:
+                        self.poll()
+                except Exception as e:
+                    self.journal("monitor_crash", error=str(e))
+    """)
+    assert _run([p], ["thread-crash"], root=str(tmp_path)) == []
+
+
+def test_thread_crash_catches_pr11_dispatcher_via_local_tuple(tmp_path):
+    """The PR 11 regression shape: the dispatcher worker loop reaches
+    Thread() through a local (name, target) tuple, so a target= match
+    alone misses it — the escaping worker-loop reference must flag."""
+    p = _write(tmp_path, "caffe_mpi_tpu/serving/batching.py", """
+        import threading
+
+        class Batcher:
+            def ensure_threads(self):
+                specs = [("dispatch", self._dispatch_loop),
+                         ("harvest", self._harvest_loop)]
+                for name, target in specs:
+                    t = threading.Thread(target=target, name=name,
+                                         daemon=True)
+                    t.start()
+
+            def _dispatch_loop(self):
+                while not self._closed:
+                    self._dispatch_once()
+
+            def _harvest_loop(self):
+                try:
+                    while not self._closed:
+                        self._harvest_once()
+                except Exception as e:
+                    self._journal("harvest_crash", error=str(e))
+    """)
+    findings = _run([p], ["thread-crash"], root=str(tmp_path))
+    assert len(findings) == 1
+    assert "_dispatch_loop" in findings[0].message
+
+
+def test_thread_crash_discarded_pool_submit_flagged_kept_clean(tmp_path):
+    p = _write(tmp_path, "caffe_mpi_tpu/serving/workers.py", """
+        def fan_out(pool, records):
+            for r in records:
+                pool.submit(_render, r)
+
+        def fan_out_kept(pool, records):
+            futs = [pool.submit(_render, r) for r in records]
+            return futs
+
+        def _render(r):
+            return r.decode()
+    """)
+    findings = _run([p], ["thread-crash"], root=str(tmp_path))
+    assert len(findings) == 1
+    assert "discards its future" in findings[0].message
+
+
+def test_thread_crash_honors_waiver(tmp_path):
+    p = _write(tmp_path, "caffe_mpi_tpu/serving/beat.py", """
+        import threading
+
+        class Beat:
+            def start(self):
+                threading.Thread(target=self._loop,
+                                 daemon=True).start()
+
+            # lint: ok(thread-crash) — fixture: a dead beat IS the
+            # failure signal; the supervisor mourns the silence
+            def _loop(self):
+                while True:
+                    self.publish()
+    """)
+    assert _run([p], ["thread-crash"], root=str(tmp_path)) == []
+
+
+def test_thread_crash_stale_waiver_reported(tmp_path):
+    p = _write(tmp_path, "caffe_mpi_tpu/serving/beat.py", """
+        import threading
+
+        class Beat:
+            def start(self):
+                threading.Thread(target=self._loop,
+                                 daemon=True).start()
+
+            # lint: ok(thread-crash) — fixture: nothing fires here
+            def _loop(self):
+                try:
+                    while True:
+                        self.publish()
+                except Exception as e:
+                    self.journal("beat_crash", error=str(e))
+    """)
+    findings = lint.run_lint([p], select=["thread-crash"],
+                             root=str(tmp_path), stale=True)
+    assert len(findings) == 1
+    assert findings[0].pass_name == "stale-waiver"
+    assert "thread-crash" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# failure-path family (ISSUE 20): deadline-discipline
+
+def test_deadline_catches_unbounded_subprocess_and_result(tmp_path):
+    p = _write(tmp_path, "tools/probe.py", """
+        import subprocess
+
+        def probe(cmd, fut):
+            subprocess.run(cmd, capture_output=True)
+            return fut.result()
+    """)
+    findings = _run([p], ["deadline-discipline"], root=str(tmp_path))
+    assert len(findings) == 2
+    msgs = " ".join(f.message for f in findings)
+    assert "hang no" in msgs
+
+
+def test_deadline_bounded_calls_are_clean(tmp_path):
+    p = _write(tmp_path, "tools/probe.py", """
+        import subprocess
+
+        def probe(cmd, fut):
+            subprocess.run(cmd, capture_output=True, timeout=60)
+            return fut.result(timeout=30)
+    """)
+    assert _run([p], ["deadline-discipline"], root=str(tmp_path)) == []
+
+
+def test_deadline_module_level_call_is_covered(tmp_path):
+    """Smoke scripts run subprocess at module/__main__ level, outside
+    any function the model walks — those statements must not escape."""
+    p = _write(tmp_path, "tools/smoke.py", """
+        import subprocess
+
+        r = subprocess.run(["python", "-c", "pass"],
+                           capture_output=True)
+    """)
+    findings = _run([p], ["deadline-discipline"], root=str(tmp_path))
+    assert len(findings) == 1
+    assert "subprocess.run" in findings[0].message
+
+
+def test_deadline_out_of_scope_path_is_clean(tmp_path):
+    """data/ is host-side io with no device adjacency — unbounded
+    waits there are blocking-under-lock's business only when a lock
+    is held."""
+    p = _write(tmp_path, "caffe_mpi_tpu/data/prefetch.py", """
+        def drain(q):
+            return q.get()
+    """)
+    assert _run([p], ["deadline-discipline"], root=str(tmp_path)) == []
+
+
+def test_deadline_honors_waiver(tmp_path):
+    p = _write(tmp_path, "caffe_mpi_tpu/serving/batching.py", """
+        def harvest(q):
+            while True:
+                # lint: ok(deadline-discipline) — fixture: sentinel-
+                # woken idle park; close() enqueues None
+                item = q.get()
+                if item is None:
+                    return
+    """)
+    assert _run([p], ["deadline-discipline"], root=str(tmp_path)) == []
+
+
+def test_deadline_stale_waiver_reported(tmp_path):
+    p = _write(tmp_path, "caffe_mpi_tpu/serving/batching.py", """
+        def harvest(q):
+            while True:
+                # lint: ok(deadline-discipline) — fixture: stale
+                item = q.get(timeout=5.0)
+                if item is None:
+                    return
+    """)
+    findings = lint.run_lint([p], select=["deadline-discipline"],
+                             root=str(tmp_path), stale=True)
+    assert len(findings) == 1
+    assert findings[0].pass_name == "stale-waiver"
+    assert "deadline-discipline" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# --profile (ISSUE 20 satellite)
+
+_INTERPROCEDURAL = ("lock-order", "blocking-under-lock",
+                    "thread-shared-mutation", "future-resolution",
+                    "typed-failure", "thread-crash",
+                    "deadline-discipline")
+
+
+def test_profile_one_shared_model_build(tmp_path):
+    """All seven interprocedural passes must share ONE tree_model
+    build per run — per-pass rebuilds are how the 5 s budget dies."""
+    _write(tmp_path, "caffe_mpi_tpu/serving/engine_fx.py", """
+        import threading
+
+        class E:
+            def start(self):
+                threading.Thread(target=self._loop,
+                                 daemon=True).start()
+
+            def _loop(self):
+                try:
+                    while True:
+                        self.step()
+                except Exception as e:
+                    self.journal("crash", error=str(e))
+    """)
+    profile = {}
+    lint.run_lint(paths=None, select=list(_INTERPROCEDURAL),
+                  root=str(tmp_path), profile=profile)
+    assert profile["model_builds"] == 1, profile
+    for name in _INTERPROCEDURAL:
+        assert name in profile["passes"], profile
+
+
+def test_profile_text_table_on_stderr(tmp_path, capsys):
+    _write(tmp_path, "ok.py", """
+        '''Replaces nothing.py:1 — fixture.'''
+    """)
+    rc = lint.main(["--profile", "--no-stale", "--select", "host-sync",
+                    str(tmp_path / "ok.py")])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "lint --profile:" in err
+    assert "host-sync" in err
+    assert "shared model build(s)" in err
+
+
+def test_profile_json_envelope_and_bare_json_unchanged(tmp_path, capsys):
+    bad = _write(tmp_path, "bad.py", """
+        def f(xs):
+            return [float(x) for x in xs]
+    """)
+    rc = lint.main(["--profile", "--json", "--no-stale",
+                    "--select", "host-sync", bad])
+    assert rc == 1
+    out = json.loads(capsys.readouterr().out)
+    # --profile + --json opts into the envelope...
+    assert set(out) == {"findings", "profile"}
+    assert out["findings"][0]["pass"] == "host-sync"
+    assert "passes" in out["profile"]
+    assert "model_builds" in out["profile"]
+    # ...while plain --json keeps the bare-array contract
+    rc = lint.main(["--json", "--no-stale", "--select", "host-sync", bad])
+    assert rc == 1
+    out = json.loads(capsys.readouterr().out)
+    assert isinstance(out, list) and out[0]["pass"] == "host-sync"

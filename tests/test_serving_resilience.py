@@ -591,3 +591,149 @@ class TestHttpFront:
                 assert "decode" in doc["error"]
             finally:
                 web.close()
+
+
+# ---------------------------------------------------------------------------
+# failure-path behavior fixes (ISSUE 20): the sites the new lint passes
+# flagged and we FIXED rather than waived — each fix gets a regression
+
+
+class TestFailurePathLiveness:
+    def test_dispatcher_crash_is_contained_typed_and_journaled(
+            self, tmp_path):
+        """thread-crash fix: an exception out of the dispatch loop must
+        fail in-flight futures TYPED, journal serve_dispatcher_crash,
+        and re-enter the loop — never die silently with the backlog
+        parked behind a dead thread (the PR 11 wedge, as a crash)."""
+        model, weights = write_toy(tmp_path)
+        journal = str(tmp_path / "serve")
+        with ServingEngine(window_ms=0, journal=journal) as eng:
+            eng.load_model("m", model, weights)
+            # prove the path works before the injected crash
+            assert eng.classify("m", imgs(1)).shape == (1, 5)
+            real = eng._batcher._take_group
+            state = {"armed": True}
+
+            def boom(*a, **kw):
+                if state["armed"]:
+                    state["armed"] = False
+                    raise RuntimeError("injected dispatcher crash")
+                return real(*a, **kw)
+
+            eng._batcher._take_group = boom
+            fut = eng.submit("m", imgs(1)[0])
+            with pytest.raises(EngineUnhealthyError) as ei:
+                fut.result(timeout=10)
+            assert "dispatcher crashed" in str(ei.value)
+            # fail_inflight resolves the future BEFORE the journal
+            # write lands — poll briefly for the manifest
+            jpath = journal + ".serve.run.json"
+            deadline = time.perf_counter() + 5.0
+            while not os.path.exists(jpath) \
+                    and time.perf_counter() < deadline:
+                time.sleep(0.01)
+            doc = json.load(open(jpath))
+            assert doc["reason"] == "serve_dispatcher_crash"
+            assert "injected dispatcher crash" in doc["error"]
+            # the loop re-entered: the SAME thread serves the next one
+            assert eng.classify("m", imgs(1, seed=1)).shape == (1, 5)
+
+    def test_shed_submit_constructs_no_future(self, tmp_path, monkeypatch):
+        """future-resolution fix (the PR 7 shape): an admission raise —
+        shed, closed, unhealthy — must happen BEFORE the request and
+        its Future exist, so a rejected submit can never strand a
+        pending-forever future."""
+        from caffe_mpi_tpu.serving import batcher as batcher_mod
+        model, weights = write_toy(tmp_path)
+        built = []
+        real_req = batcher_mod._Request
+
+        def counting_req(*a, **kw):
+            r = real_req(*a, **kw)
+            built.append(r)
+            return r
+
+        monkeypatch.setattr(batcher_mod, "_Request", counting_req)
+        with ServingEngine(window_ms=60_000, queue_limit=1) as eng:
+            eng.load_model("m", model, weights)
+            eng.submit("m", imgs(1)[0])
+            assert len(built) == 1
+            with pytest.raises(ShedError):
+                eng.submit("m", imgs(1)[0])
+            assert len(built) == 1  # the shed built nothing
+            eng._healthy = False
+            with pytest.raises(EngineUnhealthyError):
+                eng.submit("m", imgs(1)[0])
+            eng._healthy = True
+            assert len(built) == 1
+        with pytest.raises(EngineClosedError):
+            eng.submit("m", imgs(1)[0])
+        assert len(built) == 1
+
+    def test_probe_thread_crash_journals_not_silent(self, tmp_path):
+        """thread-crash fix: the async recovery-probe thread entry must
+        catch a raising probe_recovery and journal serve_probe_crash —
+        a silent death leaves the breaker open with no signal."""
+        model, weights = write_toy(tmp_path)
+        journal = str(tmp_path / "probe")
+        with ServingEngine(window_ms=0, journal=journal) as eng:
+            eng.load_model("m", model, weights)
+            eng.probe_recovery = lambda *a, **kw: (_ for _ in ()).throw(
+                RuntimeError("injected probe crash"))
+            eng._probe_recovery_guarded()  # must not raise
+            doc = json.load(open(journal + ".serve.run.json"))
+            assert doc["reason"] == "serve_probe_crash"
+            assert "injected probe crash" in doc["error"]
+
+    def test_classify_gather_is_deadline_bounded(self, tmp_path):
+        """deadline-discipline fix: classify's future gather takes a
+        timeout — a wedged dispatcher surfaces as TimeoutError in the
+        caller, never an unbounded f.result() hang."""
+        import concurrent.futures as cf
+        model, weights = write_toy(tmp_path)
+        with ServingEngine(window_ms=0) as eng:
+            eng.load_model("m", model, weights)
+            eng.submit = lambda *a, **kw: cf.Future()  # never resolves
+            t0 = time.perf_counter()
+            with pytest.raises(cf.TimeoutError):
+                eng.classify("m", imgs(1), timeout=0.2)
+            assert time.perf_counter() - t0 < 5.0
+
+    def test_wait_snapshots_join_is_bounded(self):
+        """deadline-discipline fix: a wedged async snapshot writer
+        (dead-tunnel device fetch) must fail wait_snapshots loudly
+        within the timeout, not hang the exit path forever."""
+        from caffe_mpi_tpu.solver.solver import Solver
+
+        class Stub:
+            pass
+
+        stub = Stub()
+        release = threading.Event()
+        stub._snapshot_thread = threading.Thread(
+            target=release.wait, args=(10.0,), daemon=True)
+        stub._snapshot_thread.start()
+        try:
+            with pytest.raises(RuntimeError) as ei:
+                Solver.wait_snapshots(stub, timeout=0.1)
+            assert "wedged" in str(ei.value)
+        finally:
+            release.set()
+            stub._snapshot_thread.join(5.0)
+
+    def test_wait_snapshots_reraises_writer_error_after_join(self):
+        """The bounded join must still deliver a finished writer's
+        failure: a checkpoint the user believes exists but doesn't
+        must not pass silently."""
+        from caffe_mpi_tpu.solver.solver import Solver
+
+        class Stub:
+            pass
+
+        stub = Stub()
+        stub._snapshot_thread = None
+        stub._snapshot_error = (700, OSError("disk full"))
+        with pytest.raises(RuntimeError) as ei:
+            Solver.wait_snapshots(stub, timeout=0.1)
+        assert "iteration 700" in str(ei.value)
+        assert stub._snapshot_error is None
